@@ -1,0 +1,24 @@
+"""TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas reimplementation of the capability surface of
+``cybera/distributed_tensorflow_ibm_mnist`` (a TF1 parameter-server MNIST
+trainer for IBM Cloud GPU workers — see SURVEY.md; the reference mount was
+empty at survey time, so citations point at BASELINE.json / SURVEY.md
+reconstruction tags instead of file:line).
+
+Reference capability -> TPU-native design mapping (SURVEY.md §2.2, §2.4):
+
+* TF1 graph executor + feed_dict/session.run hot loop
+  -> pure jitted train step; the whole forward/backward/update lowers to a
+     single XLA HLO module; data lives on-device, batches are gathered
+     inside a ``lax.scan`` epoch so zero host<->device traffic per step.
+* tf.train.Server / ClusterSpec chief-ps-worker topology + NCCL all-reduce
+  -> SPMD over a ``jax.sharding.Mesh``; gradients are ``psum``-ed over the
+     ``data`` mesh axis inside the compiled step (XLA collectives over ICI).
+* IBM-Cloud Kubernetes submit scripts
+  -> ``launch/`` TPU-VM process bootstrap + config presets + CLI.
+* MonitoredTrainingSession checkpoint hook
+  -> ``utils/checkpoint.py`` (orbax), full train-state round-trip.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,29 @@
+"""Core training engine: train state, compiled steps, epoch runner, trainer.
+
+This layer replaces the reference's L4 training loop (SURVEY.md §1:
+``MonitoredTrainingSession`` + per-step ``sess.run(train_op, feed_dict=...)``)
+with a pure, fully-jitted design: the whole
+forward/backward/optimizer-update — and in the fast path an entire epoch of
+steps via ``lax.scan`` with on-device batch gathers — compiles to a single
+XLA module, eliminating the reference's per-step host->device feed and
+per-step variable RPCs (SURVEY.md §3.1 "hot-loop pathologies").
+"""
+
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
+    make_epoch_runner,
+    make_eval_fn,
+    make_train_step,
+)
+
+__all__ = ["TrainState", "make_train_step", "make_eval_fn", "make_epoch_runner", "Trainer"]
+
+
+def __getattr__(name):
+    # Trainer imports the parallel subpackage (which imports core.state);
+    # loading it lazily keeps `import ...parallel` free of the cycle.
+    if name == "Trainer":
+        from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+
+        return Trainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

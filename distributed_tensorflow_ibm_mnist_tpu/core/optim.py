@@ -1,0 +1,42 @@
+"""Optimizer + LR-schedule construction from a RunConfig.
+
+The reference used a bare SGD/Adam ``optimizer.minimize`` (SURVEY.md §1 L3);
+here schedules and decoupled weight decay come from optax and are part of the
+compiled update.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+
+def make_schedule(config: RunConfig, total_steps: int) -> optax.Schedule:
+    if config.schedule == "constant":
+        return optax.constant_schedule(config.lr)
+    if config.schedule == "cosine":
+        return optax.cosine_decay_schedule(config.lr, max(total_steps, 1))
+    if config.schedule == "warmup_cosine":
+        warmup = min(config.warmup_steps, max(total_steps - 1, 1))
+        return optax.warmup_cosine_decay_schedule(
+            0.0, config.lr, warmup, max(total_steps, warmup + 1)
+        )
+    raise ValueError(f"unknown schedule {config.schedule!r}")
+
+
+def make_optimizer(config: RunConfig, total_steps: int) -> optax.GradientTransformation:
+    schedule = make_schedule(config, total_steps)
+    if config.optimizer == "adam":
+        tx = optax.adam(schedule)
+    elif config.optimizer == "adamw":
+        tx = optax.adamw(schedule, weight_decay=config.weight_decay)
+    elif config.optimizer == "sgd":
+        tx = optax.sgd(schedule)
+    elif config.optimizer == "momentum":
+        tx = optax.sgd(schedule, momentum=config.momentum, nesterov=True)
+    else:
+        raise ValueError(f"unknown optimizer {config.optimizer!r}")
+    if config.weight_decay and config.optimizer in ("sgd", "momentum", "adam"):
+        tx = optax.chain(optax.add_decayed_weights(config.weight_decay), tx)
+    return tx

@@ -1,0 +1,49 @@
+"""Immutable train state pytree.
+
+The reference kept mutable graph variables on parameter servers, updated via
+per-step gRPC (SURVEY.md §3.1).  Here the full training state — params,
+BatchNorm stats, optimizer state, step counter, RNG key — is one functional
+pytree threaded through the compiled step, so "state update" is a pure
+device-resident computation with no cross-process traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """Everything needed to continue training, as a single pytree.
+
+    ``batch_stats`` is ``{}`` for stateless models (MLP/LeNet) and the flax
+    ``batch_stats`` collection for BatchNorm models (ResNets).
+    """
+
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, model, tx, rng: jax.Array, sample_input: jax.Array) -> "TrainState":
+        """Initialize from a model + optax transform + sample batch shape."""
+        init_rng, state_rng = jax.random.split(rng)
+        variables = model.init({"params": init_rng}, sample_input, train=False)
+        params = variables.get("params", {})
+        batch_stats = variables.get("batch_stats", {})
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+            rng=state_rng,
+        )
+
+    def param_count(self) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(self.params))

@@ -1,0 +1,178 @@
+"""Compiled train/eval steps and the scan-based epoch runner.
+
+Design (the [B:5] "single XLA HLO module" requirement, SURVEY.md §2.2 row 1):
+
+* ``make_train_step`` — pure ``(state, batch) -> (state, metrics)``:
+  forward + backward + optimizer update in one traced function.  With
+  ``axis_name`` set, gradients/metrics are mean-reduced across the data
+  mesh axis with ``lax.pmean`` — the XLA-collective replacement for the
+  reference's NCCL all-reduce (SURVEY.md §2.4).
+* ``make_epoch_runner`` — an entire epoch as ONE compiled call: the dataset
+  stays device-resident (uint8), a fresh permutation is drawn on device, and
+  ``lax.scan`` gathers each minibatch with a device-side take.  Zero
+  host->device transfers per step, unlike the reference's per-step
+  ``feed_dict`` copy (SURVEY.md §3.1).
+* ``make_eval_fn`` — full-test-set accuracy/loss as one compiled scan with
+  padding + masking so any test-set size works with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+
+Batch = dict[str, jax.Array]
+
+
+def _as_input(images: jax.Array) -> jax.Array:
+    """uint8 [0,255] -> float32 [0,1]; fused into the first conv by XLA."""
+    if images.dtype == jnp.uint8:
+        return images.astype(jnp.float32) / 255.0
+    return images
+
+
+def make_loss_fn(model, label_smoothing: float = 0.0) -> Callable:
+    """Cross-entropy loss closure over a flax model.
+
+    Returns ``loss_fn(params, batch_stats, batch, dropout_rng, train)``
+    -> ``(loss, (new_batch_stats, logits))``.  ``label_smoothing`` applies to
+    the training loss only (eval always reports unsmoothed cross-entropy).
+    """
+
+    def loss_fn(params, batch_stats, batch: Batch, dropout_rng, train: bool = True):
+        variables: dict[str, Any] = {"params": params}
+        has_stats = bool(batch_stats)
+        if has_stats:
+            variables["batch_stats"] = batch_stats
+        kwargs: dict[str, Any] = {"train": train}
+        if train:
+            kwargs["rngs"] = {"dropout": dropout_rng}
+        if has_stats and train:
+            logits, updated = model.apply(
+                variables, _as_input(batch["image"]), mutable=["batch_stats"], **kwargs
+            )
+            new_stats = updated["batch_stats"]
+        else:
+            logits = model.apply(variables, _as_input(batch["image"]), **kwargs)
+            new_stats = batch_stats
+        if train and label_smoothing > 0.0:
+            n_cls = logits.shape[-1]
+            targets = optax.smooth_labels(
+                jax.nn.one_hot(batch["label"], n_cls), label_smoothing
+            )
+            loss = optax.softmax_cross_entropy(logits, targets).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
+        return loss, (new_stats, logits)
+
+    return loss_fn
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    axis_name: str | None = None,
+    label_smoothing: float = 0.0,
+):
+    """Build the pure train step; ``axis_name`` enables cross-replica psum.
+
+    The returned function is NOT jitted — callers jit it directly, wrap it in
+    ``shard_map`` (parallel/data_parallel.py), or scan it (epoch runner).
+    """
+    loss_fn = make_loss_fn(model, label_smoothing)
+
+    def train_step(state: TrainState, batch: Batch):
+        dropout_rng = jax.random.fold_in(state.rng, state.step)
+        if axis_name is not None:
+            # decorrelate dropout masks across replicas (state.rng is replicated)
+            dropout_rng = jax.random.fold_in(dropout_rng, jax.lax.axis_index(axis_name))
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (new_stats, logits)), grads = grad_fn(
+            state.params, state.batch_stats, batch, dropout_rng
+        )
+        accuracy = jnp.mean(logits.argmax(-1) == batch["label"])
+        if axis_name is not None:
+            # The NCCL-all-reduce replacement: one fused cross-replica mean
+            # over the ICI mesh axis, inside the compiled step.
+            grads, loss, accuracy = jax.lax.pmean((grads, loss, accuracy), axis_name)
+            if state.batch_stats:
+                new_stats = jax.lax.pmean(new_stats, axis_name)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, {"loss": loss, "accuracy": accuracy}
+
+    return train_step
+
+
+def make_epoch_runner(
+    model,
+    tx: optax.GradientTransformation,
+    batch_size: int,
+    axis_name: str | None = None,
+    label_smoothing: float = 0.0,
+):
+    """One full epoch as a single compiled call.
+
+    ``run_epoch(state, images, labels, epoch_rng)`` draws a device-side
+    permutation, scans ``train_step`` over ``n // batch_size`` minibatches
+    gathered on device, and returns ``(state, per-step stacked metrics)``.
+    """
+    train_step = make_train_step(model, tx, axis_name=axis_name, label_smoothing=label_smoothing)
+
+    def run_epoch(state: TrainState, images: jax.Array, labels: jax.Array, epoch_rng: jax.Array):
+        n = images.shape[0]
+        steps = n // batch_size
+        perm = jax.random.permutation(epoch_rng, n)[: steps * batch_size]
+        perm = perm.reshape(steps, batch_size)
+
+        def body(carry, idx):
+            batch = {"image": jnp.take(images, idx, axis=0), "label": jnp.take(labels, idx, axis=0)}
+            return train_step(carry, batch)
+
+        return jax.lax.scan(body, state, perm)
+
+    return run_epoch
+
+
+def make_eval_fn(model, batch_size: int = 2000):
+    """Full-dataset eval as one compiled scan (pad + mask for any size)."""
+    loss_fn = make_loss_fn(model)
+
+    def eval_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        n = images.shape[0]
+        n_batches = -(-n // batch_size)
+        pad = n_batches * batch_size - n
+        images_p = jnp.pad(images, ((0, pad),) + ((0, 0),) * (images.ndim - 1))
+        labels_p = jnp.pad(labels, ((0, pad),))
+        valid = (jnp.arange(n_batches * batch_size) < n).astype(jnp.float32)
+        images_b = images_p.reshape((n_batches, batch_size) + images.shape[1:])
+        labels_b = labels_p.reshape(n_batches, batch_size)
+        valid_b = valid.reshape(n_batches, batch_size)
+
+        def body(carry, xs):
+            imgs, labs, v = xs
+            loss, (_, logits) = loss_fn(
+                state.params, state.batch_stats, {"image": imgs, "label": labs},
+                jax.random.PRNGKey(0), train=False,
+            )
+            correct = jnp.sum((logits.argmax(-1) == labs) * v)
+            losses = optax.softmax_cross_entropy_with_integer_labels(logits, labs)
+            return (carry[0] + correct, carry[1] + jnp.sum(losses * v)), None
+
+        (correct, loss_sum), _ = jax.lax.scan(
+            body, (jnp.zeros(()), jnp.zeros(())), (images_b, labels_b, valid_b)
+        )
+        return {"accuracy": correct / n, "loss": loss_sum / n}
+
+    return eval_fn

@@ -1,0 +1,186 @@
+"""High-level Trainer: config -> data -> compiled epoch loop -> metrics.
+
+This is the replacement for the reference's ``main()`` +
+``MonitoredTrainingSession`` orchestration (SURVEY.md §3.1): build the model
+and optimizer from a ``RunConfig``, place the dataset on device (sharded over
+the ``data`` mesh axis when ``dp > 1``), and drive the compiled epoch runner,
+emitting the BASELINE.json:2 metrics of record (images/sec/chip and
+wall-clock-to-target-accuracy).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_ibm_mnist_tpu.core.optim import make_optimizer
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_epoch_runner, make_eval_fn
+from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+    make_dp_epoch_runner,
+    replicate,
+    shard_dataset,
+)
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+
+class Trainer:
+    """Owns the compiled functions + train state for one run."""
+
+    def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None):
+        self.config = config
+        self.writer = writer or MetricWriter(path=config.metrics_path, stdout=not config.quiet)
+
+        data = load_dataset(
+            config.dataset, n_train=config.n_train, n_test=config.n_test,
+            seed=config.seed, synthetic=config.synthetic,
+        )
+        self.num_classes = data["num_classes"]
+
+        self.dp = config.dp if config.dp else len(jax.devices())
+        if self.dp > 1 and mesh is None:
+            mesh = make_mesh(dp=self.dp)
+        self.mesh = mesh
+
+        n_train = data["train_images"].shape[0]
+        self.steps_per_epoch = n_train // config.batch_size
+        total_steps = self.steps_per_epoch * config.epochs
+
+        self.model = get_model(
+            config.model, num_classes=self.num_classes, **config.model_kwargs
+        )
+        self.tx = make_optimizer(config, total_steps)
+
+        root = jax.random.PRNGKey(config.seed)
+        state_rng, self._data_rng = jax.random.split(root)
+        sample = jnp.zeros((1,) + data["train_images"].shape[1:], jnp.uint8)
+        state = TrainState.create(self.model, self.tx, state_rng, sample)
+
+        if self.dp > 1:
+            self.train_images, self.train_labels = shard_dataset(
+                self.mesh, data["train_images"], data["train_labels"]
+            )
+            state = replicate(self.mesh, state)
+            self._run_epoch = make_dp_epoch_runner(
+                self.model, self.tx, config.batch_size, self.mesh,
+                label_smoothing=config.label_smoothing,
+            )
+        else:
+            self.train_images = jax.device_put(data["train_images"])
+            self.train_labels = jax.device_put(data["train_labels"])
+            self._run_epoch = jax.jit(
+                make_epoch_runner(
+                    self.model, self.tx, config.batch_size,
+                    label_smoothing=config.label_smoothing,
+                ),
+                donate_argnums=(0,),
+            )
+
+        self.test_images = jax.device_put(data["test_images"])
+        self.test_labels = jax.device_put(data["test_labels"])
+        self._eval = jax.jit(make_eval_fn(self.model, config.eval_batch_size))
+        self.state = state
+        self.history: list[dict[str, Any]] = []
+
+        self._ckpt = None
+        if config.checkpoint_dir:
+            from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
+
+            self._ckpt = CheckpointManager(config.checkpoint_dir)
+
+    def save_checkpoint(self, wait: bool = True) -> int | None:
+        if self._ckpt is None:
+            return None
+        return self._ckpt.save(self.state, wait=wait)
+
+    def restore_checkpoint(self, step: int | None = None) -> int:
+        """Resume from the checkpoint dir; returns the restored step."""
+        if self._ckpt is None:
+            raise ValueError("no checkpoint_dir configured")
+        restored = self._ckpt.restore(jax.device_get(self.state), step=step)
+        if self.dp > 1:
+            restored = replicate(self.mesh, restored)
+        else:
+            restored = jax.device_put(restored)
+        self.state = restored
+        return int(jax.device_get(self.state.step))
+
+    def evaluate(self) -> dict[str, float]:
+        out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))
+        return {k: float(v) for k, v in out.items()}
+
+    def fit(self) -> dict[str, Any]:
+        """Run the configured number of epochs (early-stop on target acc)."""
+        cfg = self.config
+        if cfg.epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {cfg.epochs}")
+        chips = self.dp if self.dp > 1 else 1
+        t0 = time.perf_counter()
+        epoch_times: list[float] = []
+        time_to_target = None
+        best_acc = 0.0
+
+        for epoch in range(cfg.epochs):
+            epoch_rng = jax.random.fold_in(self._data_rng, epoch)
+            te = time.perf_counter()
+            self.state, metrics = self._run_epoch(
+                self.state, self.train_images, self.train_labels, epoch_rng
+            )
+            metrics = jax.tree.map(lambda m: float(jnp.mean(m)), jax.device_get(metrics))
+            epoch_time = time.perf_counter() - te
+            epoch_times.append(epoch_time)
+            images = self.steps_per_epoch * cfg.batch_size
+            record = {
+                "epoch": epoch,
+                "train_loss": metrics["loss"],
+                "train_accuracy": metrics["accuracy"],
+                "epoch_time_s": round(epoch_time, 4),
+                "images_per_sec": round(images / epoch_time, 1),
+                "images_per_sec_per_chip": round(images / epoch_time / chips, 1),
+            }
+            if (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1:
+                ev = self.evaluate()
+                record["test_accuracy"] = ev["accuracy"]
+                record["test_loss"] = ev["loss"]
+                best_acc = max(best_acc, ev["accuracy"])
+                if (
+                    time_to_target is None
+                    and cfg.target_accuracy
+                    and ev["accuracy"] >= cfg.target_accuracy
+                ):
+                    time_to_target = time.perf_counter() - t0
+            self.history.append(record)
+            self.writer.write("epoch", step=int((epoch + 1) * self.steps_per_epoch), **record)
+            if self._ckpt is not None and cfg.checkpoint_every and (epoch + 1) % cfg.checkpoint_every == 0:
+                self.save_checkpoint(wait=False)
+            if time_to_target is not None and cfg.target_accuracy:
+                break
+
+        total_time = time.perf_counter() - t0
+        # First epoch includes XLA compile; steady-state rate excludes it.
+        steady = epoch_times[1:] or epoch_times
+        images = self.steps_per_epoch * cfg.batch_size
+        summary = {
+            "name": cfg.name,
+            "epochs_run": len(epoch_times),
+            "total_time_s": round(total_time, 3),
+            "compile_overhead_s": round(epoch_times[0] - min(epoch_times), 3),
+            "best_test_accuracy": best_acc,
+            "time_to_target_s": round(time_to_target, 3) if time_to_target else None,
+            "target_accuracy": cfg.target_accuracy,
+            "images_per_sec": round(images / (sum(steady) / len(steady)), 1),
+            "images_per_sec_per_chip": round(images / (sum(steady) / len(steady)) / chips, 1),
+            "param_count": self.state.param_count() if self.dp == 1 else None,
+        }
+        if self._ckpt is not None:
+            self.save_checkpoint(wait=True)
+        self.writer.write("summary", **summary)
+        return summary

@@ -1,0 +1,23 @@
+"""Data subsystem: deterministic synthetic datasets + on-device pipelines.
+
+Replaces the reference's L5 data layer (SURVEY.md §1:
+``input_data.read_data_sets`` + per-step ``next_batch`` into ``feed_dict``).
+There is no network egress in this environment and no MNIST cache on disk
+(SURVEY.md §7), so the default data source is a deterministic, seeded,
+class-conditional renderer (`synthetic.py`). Real IDX/npz loading is attempted
+first when a cache exists (`loaders.py`).
+"""
+
+from distributed_tensorflow_ibm_mnist_tpu.data.loaders import load_dataset
+from distributed_tensorflow_ibm_mnist_tpu.data.synthetic import (
+    synthetic_cifar10,
+    synthetic_fashion_mnist,
+    synthetic_mnist,
+)
+
+__all__ = [
+    "load_dataset",
+    "synthetic_mnist",
+    "synthetic_fashion_mnist",
+    "synthetic_cifar10",
+]

@@ -1,0 +1,8 @@
+"""Launcher layer — replaces the reference's IBM-Cloud K8s submit scripts.
+
+The reference launched one pod per chief/ps/worker task with role flags
+injected via env (SURVEY.md §3.5).  SPMD has no roles: every process runs the
+same program, so the launcher reduces to (a) optional multi-host process
+bootstrap (``tpu_vm.py``: ``jax.distributed.initialize``) and (b) a CLI that
+resolves a config preset plus overrides and calls the Trainer (``cli.py``).
+"""

@@ -1,0 +1,79 @@
+"""Training CLI: preset + overrides -> Trainer.
+
+Replaces the reference's ``tf.app.flags`` entry point (SURVEY.md §3.1) minus
+the role/cluster flags that SPMD makes obsolete.  Usage:
+
+    python -m distributed_tensorflow_ibm_mnist_tpu.launch.cli \
+        --preset mnist_lenet_1chip --set epochs=5 --set lr=5e-4
+
+``--set key=value`` overrides any RunConfig field (values parsed as Python
+literals when possible, else kept as strings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import PRESETS, RunConfig, get_preset
+
+
+def _parse_override(kv: str) -> tuple[str, object]:
+    if "=" not in kv:
+        raise argparse.ArgumentTypeError(f"override {kv!r} must be key=value")
+    key, raw = kv.split("=", 1)
+    try:
+        value = ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        value = raw
+    return key, value
+
+
+def build_config(argv: list[str] | None = None) -> RunConfig:
+    parser = argparse.ArgumentParser(
+        prog="distributed_tensorflow_ibm_mnist_tpu.launch.cli",
+        description="TPU-native trainer (see BASELINE.md for the preset configs)",
+    )
+    parser.add_argument(
+        "--preset", choices=sorted(PRESETS), default=None,
+        help="named benchmark config from BASELINE.json:6-12",
+    )
+    parser.add_argument(
+        "--set", dest="overrides", action="append", default=[], type=_parse_override,
+        metavar="KEY=VALUE", help="override any RunConfig field (repeatable)",
+    )
+    parser.add_argument(
+        "--coordinator", default=None,
+        help="multi-host: coordinator address for jax.distributed.initialize",
+    )
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    if args.coordinator or (args.num_processes or 0) > 1:
+        from distributed_tensorflow_ibm_mnist_tpu.launch.tpu_vm import bootstrap
+
+        info = bootstrap(args.coordinator, args.num_processes, args.process_id)
+        print(json.dumps({"kind": "bootstrap", **info}), flush=True)
+
+    config = get_preset(args.preset) if args.preset else RunConfig()
+    overrides = dict(args.overrides)
+    unknown = set(overrides) - set(config.to_dict())
+    if unknown:
+        parser.error(f"unknown config fields: {sorted(unknown)}")
+    return config.replace(**overrides)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+
+    config = build_config(argv)
+    summary = Trainer(config).fit()
+    print(json.dumps({"kind": "final", **summary}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Model zoo (flax.linen modules).
+
+Covers the reference's model layer (SURVEY.md §1 L3: the TF-tutorial
+LeNet-style MNIST CNN) plus the scale-out configs from BASELINE.md
+(MLP smoke model, ResNet-20, ResNet-50).
+
+Every model follows one calling convention:
+``model(x, train: bool = False)`` with optional ``dropout`` RNG and
+``batch_stats`` collection, images NHWC float in [0, 1].
+"""
+
+from __future__ import annotations
+
+from distributed_tensorflow_ibm_mnist_tpu.models.lenet import LeNet5
+from distributed_tensorflow_ibm_mnist_tpu.models.mlp import MLP
+from distributed_tensorflow_ibm_mnist_tpu.models.resnet import ResNet, ResNet20, ResNet50
+
+_REGISTRY = {
+    "mlp": MLP,
+    "lenet5": LeNet5,
+    "resnet20": ResNet20,
+    "resnet50": ResNet50,
+}
+
+
+def get_model(name: str, **kwargs):
+    """Build a model from the registry by name."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}") from None
+    return cls(**kwargs)
+
+
+def available_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+__all__ = ["MLP", "LeNet5", "ResNet", "ResNet20", "ResNet50", "get_model", "available_models"]

@@ -1,0 +1,38 @@
+"""LeNet-class MNIST CNN — the reference's 99%-capable model.
+
+The reference's net is the TF-tutorial LeNet-style graph
+(SURVEY.md §2.1 "MNIST CNN model graph":
+conv(5x5,32) -> maxpool -> conv(5x5,64) -> maxpool -> fc(1024)+dropout ->
+fc(10) softmax, built with ``tf.nn.conv2d``/``max_pool`` [B:5][R-high]).
+This is the same architecture expressed as a flax module with bfloat16
+compute so the convs/matmuls land on the MXU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet5(nn.Module):
+    """conv32 -> pool -> conv64 -> pool -> fc1024 + dropout -> fc(num_classes)."""
+
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,30 @@
+"""Two-layer MLP — the CPU smoke-test model (BASELINE.md config 1)."""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Flatten -> Dense(hidden) x N -> Dense(num_classes).
+
+    Compute runs in ``dtype`` (bfloat16 by default for the MXU); parameters
+    are kept in float32 and logits are returned in float32 for a stable
+    softmax/loss.
+    """
+
+    hidden: Sequence[int] = (256,)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, width in enumerate(self.hidden):
+            x = nn.Dense(width, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)

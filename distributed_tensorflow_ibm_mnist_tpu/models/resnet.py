@@ -1,0 +1,125 @@
+"""ResNets for the scale-out configs (BASELINE.md configs 4-5).
+
+ResNet-20 (CIFAR-style basic blocks, widths 16/32/64) for Fashion-MNIST and
+ResNet-50 (bottleneck blocks) for CIFAR-10.  BatchNorm statistics live in the
+``batch_stats`` collection; under data parallelism pass ``axis_name`` so the
+batch moments are computed over the *global* batch via a cross-replica mean
+(the XLA-collective analog of TF's cross-replica BN).  Compute in bfloat16,
+params and BN stats in float32.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    norm: Any = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides, padding="SAME", name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding="SAME", name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), self.strides, name="proj")(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    norm: Any = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), self.strides, padding="SAME", name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides, name="proj")(residual)
+            residual = self.norm(name="bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Generic ResNet; ``low_res=True`` uses the CIFAR stem (3x3, no maxpool)."""
+
+    stage_sizes: Sequence[int]
+    block: Any = BasicBlock
+    num_classes: int = 10
+    width: int = 16
+    low_res: bool = True
+    dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    axis_name: str | None = None  # set under shard_map for cross-replica BN
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            dtype=self.dtype,
+            axis_name=self.axis_name if train else None,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        if self.low_res:
+            x = conv(self.width, (3, 3), padding="SAME", name="stem")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding="SAME", name="stem")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if self.low_res:
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2**i)
+            for j in range(n_blocks):
+                strides = (2, 2) if (i > 0 and j == 0) else (1, 1)
+                x = self.block(
+                    filters, strides=strides, dtype=self.dtype, norm=norm,
+                    name=f"stage{i}_block{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet20(num_classes: int = 10, dtype: Any = jnp.bfloat16, axis_name: str | None = None, **kw):
+    """CIFAR-style ResNet-20: 3 stages x 3 basic blocks, widths 16/32/64."""
+    return ResNet(
+        stage_sizes=(3, 3, 3), block=BasicBlock, num_classes=num_classes,
+        width=16, low_res=True, dtype=dtype, axis_name=axis_name, **kw,
+    )
+
+
+def ResNet50(num_classes: int = 10, dtype: Any = jnp.bfloat16, axis_name: str | None = None, low_res: bool = True, **kw):
+    """ResNet-50: bottleneck [3, 4, 6, 3], width 64 (x4 expansion)."""
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, num_classes=num_classes,
+        width=64, low_res=low_res, dtype=dtype, axis_name=axis_name, **kw,
+    )

@@ -1,0 +1,18 @@
+"""Parallelism layer: device meshes, SPMD data parallelism, TP/SP blocks.
+
+This is the TPU-native replacement for the reference's entire distributed
+stack (SURVEY.md §1 L1-L2 and §2.4): ``tf.train.Server``/ClusterSpec
+chief-ps-worker topology with gRPC variable traffic plus NCCL all-reduce
+becomes a ``jax.sharding.Mesh`` with XLA collectives over ICI inside the
+compiled step.  There are no roles and no parameter servers: every process
+runs the same program (SPMD) and gradient aggregation is a ``psum`` the
+compiler schedules onto the interconnect.
+"""
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh, shard_map_compat
+from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+    make_dp_epoch_runner,
+    shard_dataset,
+)
+
+__all__ = ["make_mesh", "shard_map_compat", "make_dp_epoch_runner", "shard_dataset"]

@@ -1,0 +1,119 @@
+"""SPMD data parallelism over the ``data`` mesh axis.
+
+Replaces the reference's between-graph-replication DP (SURVEY.md §2.3: each
+worker a full replica, gradients aggregated via parameter-server updates and
+NCCL all-reduce [B:5]) with in-graph SPMD:
+
+* the dataset is sharded across the ``data`` axis once at startup and stays
+  device-resident (uint8);
+* each device draws its own batch indices from a per-device fold of the epoch
+  RNG and computes local gradients;
+* one fused ``lax.pmean`` inside the compiled step aggregates gradients over
+  ICI — this is the entire "distributed communication backend" for DP, and it
+  compiles into the same single XLA module as the model (TF-Replicator's
+  in-graph-replication lesson, PAPERS.md [P:5]).
+
+The same ``train_step`` body is used single-device and N-device; only the
+``shard_map`` wrapper differs (SURVEY.md §7 layer 4 acceptance criterion).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
+
+AXIS = "data"
+
+
+def shard_dataset(mesh: Mesh, images: np.ndarray, labels: np.ndarray, axis: str = AXIS):
+    """Place (images, labels) sharded along batch dim over the data axis.
+
+    Drops a remainder of at most ``axis_size - 1`` samples so every device
+    holds an equal, static-shaped shard.
+    """
+    size = mesh.shape[axis]
+    n = (images.shape[0] // size) * size
+    spec_img = P(axis, *([None] * (images.ndim - 1)))
+    imgs = jax.device_put(images[:n], NamedSharding(mesh, spec_img))
+    labs = jax.device_put(labels[:n], NamedSharding(mesh, P(axis)))
+    return imgs, labs
+
+
+def replicate(mesh: Mesh, tree):
+    """Fully replicate a pytree over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def make_dp_train_step(model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0):
+    """Single DP step over a batch sharded along the data axis.
+
+    Semantically identical to the single-device step on the full global
+    batch: per-shard mean loss + gradient ``pmean`` == full-batch mean
+    gradient.  Used for per-step control flow (checkpoint-every-N, custom
+    loops); the epoch runner below is the fast path.
+    """
+    train_step = make_train_step(model, tx, axis_name=axis, label_smoothing=label_smoothing)
+    img_spec = P(axis, *([None] * 3))
+    wrapped = shard_map_compat(
+        train_step,
+        mesh,
+        in_specs=(P(), {"image": img_spec, "label": P(axis)}),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(wrapped, donate_argnums=(0,))
+
+
+def make_dp_epoch_runner(
+    model,
+    tx,
+    global_batch: int,
+    mesh: Mesh,
+    axis: str = AXIS,
+    label_smoothing: float = 0.0,
+):
+    """Epoch runner over a sharded dataset: one jitted shard_map per epoch.
+
+    ``run_epoch(state, images, labels, epoch_rng) -> (state, metrics)`` where
+    ``images``/``labels`` are sharded along the data axis and ``state`` is
+    replicated.  Each device samples from its local shard only (no
+    cross-device gathers in the hot loop); gradient pmean is the only
+    collective per step.
+    """
+    dp = mesh.shape[axis]
+    if global_batch % dp:
+        raise ValueError(f"global batch {global_batch} not divisible by dp={dp}")
+    local_batch = global_batch // dp
+    train_step = make_train_step(model, tx, axis_name=axis, label_smoothing=label_smoothing)
+
+    def local_epoch(state: TrainState, images, labels, epoch_rng):
+        # images/labels here are the LOCAL shard (shard_map body).
+        n_local = images.shape[0]
+        steps = n_local // local_batch
+        dev_rng = jax.random.fold_in(epoch_rng, jax.lax.axis_index(axis))
+        perm = jax.random.permutation(dev_rng, n_local)[: steps * local_batch]
+        perm = perm.reshape(steps, local_batch)
+
+        def body(carry, idx):
+            batch = {
+                "image": jnp.take(images, idx, axis=0),
+                "label": jnp.take(labels, idx, axis=0),
+            }
+            return train_step(carry, batch)
+
+        return jax.lax.scan(body, state, perm)
+
+    img_spec = P(axis, *([None] * 3))
+    wrapped = shard_map_compat(
+        local_epoch,
+        mesh,
+        in_specs=(P(), img_spec, P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(wrapped, donate_argnums=(0,))

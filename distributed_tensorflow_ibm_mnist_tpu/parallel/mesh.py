@@ -1,0 +1,58 @@
+"""Device-mesh construction and shard_map compatibility shim.
+
+The reference's cluster topology was a ClusterSpec built from role flags
+(SURVEY.md §1 L2).  The TPU-native analog is a named ``jax.sharding.Mesh``
+over the visible devices; parallelism strategies are just mesh axes:
+``data`` (DP), ``model`` (TP), ``seq`` (SP/ring).  Multi-host bootstrap
+(``jax.distributed.initialize``) lives in ``launch/tpu_vm.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# jax.shard_map moved out of jax.experimental around 0.6; keep one import site.
+try:  # pragma: no cover - version dependent
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (works across jax versions).
+
+    The check flag was renamed ``check_rep`` -> ``check_vma``; replicated
+    outputs produced via psum are correct but the checker can't always prove
+    it, so we disable it at this single call site.
+    """
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def make_mesh(
+    dp: int | None = None,
+    tp: int = 1,
+    sp: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(data, model, seq)`` mesh over the visible devices.
+
+    ``dp=None`` uses all remaining devices for data parallelism.  Axis sizes
+    must multiply to at most ``len(devices)``; trailing devices are unused.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None or dp == 0:
+        dp = n // (tp * sp)
+        if dp == 0:
+            raise ValueError(f"tp*sp={tp * sp} exceeds device count {n}; no room for a data axis")
+    need = dp * tp * sp
+    if need > n:
+        raise ValueError(f"mesh ({dp}x{tp}x{sp}) needs {need} devices, have {n}")
+    arr = np.array(devices[:need]).reshape(dp, tp, sp)
+    return Mesh(arr, ("data", "model", "seq"))

@@ -1,0 +1,1 @@
+"""Utilities: config presets, metrics, checkpointing, profiling."""

@@ -1,0 +1,83 @@
+"""Checkpoint/resume (orbax) — the MonitoredTrainingSession Saver analog.
+
+The one aux subsystem the reference actually had (SURVEY.md §5
+"Checkpoint / resume": chief-side automatic ``Saver`` hook; resume =
+restart pointing at the same dir [R-high]).  Here the full ``TrainState``
+pytree — params, BatchNorm stats, optimizer state, step, RNG key — round-trips
+through orbax/tensorstore, and restore works across process/device layouts
+because the state is just a pytree that gets re-placed by the caller
+(replicated or sharded) after load.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: numbered step checkpoints under one directory."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, state: TrainState, wait: bool = False) -> int:
+        """Save at the state's current step; returns the step number."""
+        step = int(state.step)
+        # device_get so the saved tree is host numpy regardless of sharding.
+        host_state = jax.device_get(state)
+        self._mgr.save(step, args=ocp.args.StandardSave(host_state))
+        if wait:
+            self._mgr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, target: TrainState, step: int | None = None) -> TrainState:
+        """Restore into the structure of ``target`` (a freshly-created state).
+
+        The caller re-places the result on devices (replicate/shard) —
+        restore itself is layout-agnostic, which is what makes resume work
+        across different process counts (SURVEY.md §5 requirement).
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {self._dir}")
+        abstract = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(x, "shape") else x,
+            jax.device_get(target),
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def save_state(directory: str, state: TrainState) -> int:
+    """One-shot save (blocks until written)."""
+    mgr = CheckpointManager(directory)
+    step = mgr.save(state, wait=True)
+    mgr.close()
+    return step
+
+
+def restore_state(directory: str, target: TrainState, step: int | None = None) -> TrainState:
+    """One-shot restore into ``target``'s structure."""
+    mgr = CheckpointManager(directory)
+    out = mgr.restore(target, step=step)
+    mgr.close()
+    return out

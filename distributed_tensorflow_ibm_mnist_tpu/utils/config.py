@@ -1,0 +1,99 @@
+"""Run configuration + the five BASELINE.md benchmark presets.
+
+Replaces the reference's config layer (SURVEY.md §5 "Config / flag system":
+``tf.app.flags`` role flags + K8s env injection).  SPMD has no chief/ps/worker
+roles, so a run is fully described by one dataclass; the BASELINE.json:6-12
+configs are named presets; CLI overrides come from ``launch/cli.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class RunConfig:
+    """Complete description of a training run."""
+
+    name: str = "run"
+    # model
+    model: str = "lenet5"
+    model_kwargs: dict[str, Any] = field(default_factory=dict)
+    # data
+    dataset: str = "mnist"
+    synthetic: bool | None = None  # None = real cache if present, else synthetic
+    n_train: int | None = None
+    n_test: int | None = None
+    # optimization
+    batch_size: int = 128  # global batch
+    epochs: int = 10
+    optimizer: str = "adam"  # adam | sgd | momentum
+    lr: float = 1e-3
+    schedule: str = "constant"  # constant | cosine | warmup_cosine
+    warmup_steps: int = 0
+    weight_decay: float = 0.0
+    momentum: float = 0.9
+    label_smoothing: float = 0.0
+    # parallelism
+    dp: int = 1  # data-parallel degree; 0 => all visible devices
+    # run control
+    seed: int = 0
+    target_accuracy: float | None = None  # stop early when test acc reaches this
+    eval_every: int = 1  # epochs between evals
+    eval_batch_size: int = 2000
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # epochs between saves; 0 = final save only (if dir set)
+    metrics_path: str | None = None  # JSONL file (always also stdout unless quiet)
+    quiet: bool = False  # suppress stdout metric lines (tests/benchmarks)
+
+    def replace(self, **kw) -> "RunConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# The five measurement configs from BASELINE.json:6-12 / BASELINE.md.
+PRESETS: dict[str, RunConfig] = {
+    # 1. "MNIST 2-layer MLP, single-process, batch=32 (CPU smoke test)"
+    "mnist_mlp_smoke": RunConfig(
+        name="mnist_mlp_smoke", model="mlp", model_kwargs={"hidden": (256,)},
+        dataset="mnist", batch_size=32, epochs=3, lr=1e-3, dp=1,
+        target_accuracy=0.97,
+    ),
+    # 2. "MNIST LeNet-5 CNN, single TPU core, batch=128"
+    "mnist_lenet_1chip": RunConfig(
+        name="mnist_lenet_1chip", model="lenet5", dataset="mnist",
+        batch_size=128, epochs=12, lr=1e-3, schedule="cosine", dp=1,
+        target_accuracy=0.99,
+    ),
+    # 3. "MNIST CNN, 8-core TPUStrategy-equivalent data-parallel, global batch=1024"
+    "mnist_cnn_dp8": RunConfig(
+        name="mnist_cnn_dp8", model="lenet5", dataset="mnist",
+        batch_size=1024, epochs=20, lr=2e-3, schedule="warmup_cosine",
+        warmup_steps=100, dp=8, target_accuracy=0.99,
+    ),
+    # 4. "Fashion-MNIST ResNet-20, v4-32 data-parallel"
+    "fashion_resnet20_dp32": RunConfig(
+        name="fashion_resnet20_dp32", model="resnet20", dataset="fashion_mnist",
+        batch_size=4096, epochs=30, optimizer="momentum", lr=0.4,
+        schedule="warmup_cosine", warmup_steps=200, weight_decay=1e-4, dp=32,
+        target_accuracy=0.90,
+    ),
+    # 5. "CIFAR-10 ResNet-50, v4-32 (stretch beyond MNIST)"
+    "cifar_resnet50_dp32": RunConfig(
+        name="cifar_resnet50_dp32", model="resnet50", dataset="cifar10",
+        batch_size=4096, epochs=40, optimizer="momentum", lr=0.4,
+        schedule="warmup_cosine", warmup_steps=300, weight_decay=1e-4, dp=32,
+        target_accuracy=0.90,
+    ),
+}
+
+
+def get_preset(name: str) -> RunConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise ValueError(f"unknown preset {name!r}; available: {sorted(PRESETS)}") from None

@@ -1,0 +1,59 @@
+"""Structured metric emission: JSONL (stdout and/or file) + TensorBoard.
+
+Replaces the reference's observability layer (SURVEY.md §5 "Metrics /
+logging": ``print``/``tf.logging`` of step, loss, accuracy, steps/sec).
+Emits exactly the metrics of record from BASELINE.json:2 —
+``images_per_sec_per_chip`` and wall-clock-to-target-accuracy — as
+machine-readable JSON lines, with optional TensorBoard event files.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, IO
+
+
+class MetricWriter:
+    """JSON-lines metric writer; one record per event.
+
+    Records carry a monotonic ``t`` (seconds since writer creation) so
+    time-to-accuracy can be reconstructed from the log alone.
+    """
+
+    def __init__(self, path: str | None = None, stdout: bool = True, tensorboard_dir: str | None = None):
+        self._file: IO[str] | None = open(path, "a") if path else None
+        self._stdout = stdout
+        self._t0 = time.perf_counter()
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception:
+                self._tb = None
+
+    def write(self, kind: str, step: int | None = None, **metrics: Any) -> dict[str, Any]:
+        record = {"kind": kind, "t": round(time.perf_counter() - self._t0, 4)}
+        if step is not None:
+            record["step"] = int(step)
+        record.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()})
+        line = json.dumps(record)
+        if self._stdout:
+            print(line, flush=True)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._tb and step is not None:
+            for k, v in metrics.items():
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(f"{kind}/{k}", v, step)
+        return record
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+        if self._tb:
+            self._tb.close()

@@ -1,0 +1,31 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+This is the SURVEY.md §4 strategy: distributed tests run against
+``--xla_force_host_platform_device_count=8`` on CPU, replacing the
+reference's "run it on K8s to find out" with a real multi-device test in CI.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize imports jax at interpreter start, which freezes
+# jax_platforms from the env before this file runs — override via config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip(f"need 8 virtual devices, have {len(devices)}")
+    return devices

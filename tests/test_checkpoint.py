@@ -1,0 +1,73 @@
+"""Checkpoint/resume round-trip (SURVEY.md §5 'Checkpoint / resume')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.core import TrainState, Trainer
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import (
+    CheckpointManager,
+    restore_state,
+    save_state,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+
+def _state(seed=0):
+    model = get_model("mlp", num_classes=10, hidden=(32,))
+    tx = optax.adam(1e-3)
+    return model, tx, TrainState.create(
+        model, tx, jax.random.PRNGKey(seed), jnp.zeros((1, 28, 28, 1), jnp.uint8)
+    )
+
+
+def test_state_roundtrip(tmp_path):
+    _, _, state = _state(seed=1)
+    state = state.replace(step=jnp.asarray(42, jnp.int32))
+    save_state(str(tmp_path / "ckpt"), state)
+    _, _, fresh = _state(seed=2)  # different init -> must be overwritten
+    restored = restore_state(str(tmp_path / "ckpt"), fresh)
+    assert int(restored.step) == 42
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_manager_latest_and_missing(tmp_path):
+    _, _, state = _state()
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(state)
+    mgr.save(state, wait=True)
+    state2 = state.replace(step=jnp.asarray(7, jnp.int32))
+    mgr.save(state2, wait=True)
+    assert mgr.latest_step() == 7
+    mgr.close()
+
+
+def test_trainer_resume_continues_training(tmp_path):
+    """Train 2 epochs, checkpoint, resume in a NEW trainer, keep training."""
+    cfg = RunConfig(
+        name="ckpt_run", model="mlp", model_kwargs={"hidden": (64,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=512, n_test=128,
+        batch_size=64, epochs=2, lr=2e-3, dp=1, quiet=True,
+        checkpoint_dir=str(tmp_path / "run_ckpt"),
+    )
+    t1 = Trainer(cfg)
+    t1.fit()
+    saved_step = int(jax.device_get(t1.state.step))
+    assert saved_step == 2 * t1.steps_per_epoch
+
+    t2 = Trainer(cfg)
+    restored_step = t2.restore_checkpoint()
+    assert restored_step == saved_step
+    for a, b in zip(jax.tree.leaves(t1.state.params), jax.tree.leaves(t2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # resumed trainer can keep training
+    t2.fit()
+    assert int(jax.device_get(t2.state.step)) > saved_step

@@ -1,0 +1,56 @@
+"""CLI: preset resolution, overrides, error handling."""
+
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.launch.cli import build_config, main
+
+
+def test_build_config_preset_and_overrides():
+    cfg = build_config(["--preset", "mnist_mlp_smoke", "--set", "epochs=7", "--set", "lr=0.01"])
+    assert cfg.name == "mnist_mlp_smoke"
+    assert cfg.epochs == 7
+    assert cfg.lr == 0.01
+
+
+def test_build_config_string_override():
+    cfg = build_config(["--set", "dataset=fashion_mnist"])
+    assert cfg.dataset == "fashion_mnist"
+
+
+def test_build_config_unknown_field_errors():
+    with pytest.raises(SystemExit):
+        build_config(["--set", "nonsense=1"])
+
+
+def test_build_config_bad_preset_errors():
+    with pytest.raises(SystemExit):
+        build_config(["--preset", "nope"])
+
+
+def test_cli_main_end_to_end(capsys):
+    rc = main([
+        "--set", "model=mlp", "--set", "model_kwargs={'hidden': (32,)}",
+        "--set", "synthetic=True", "--set", "n_train=256", "--set", "n_test=64",
+        "--set", "batch_size=32", "--set", "epochs=1", "--set", "quiet=True",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"kind": "final"' in out
+
+
+def test_parallel_subpackage_imports_standalone():
+    """Regression: importing parallel first must not hit a circular import."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "from distributed_tensorflow_ibm_mnist_tpu.parallel import make_mesh;"
+        "print('ok')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout

@@ -1,0 +1,78 @@
+"""Synthetic dataset generator: shapes, determinism, learnability surface."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.data import (
+    load_dataset,
+    synthetic_cifar10,
+    synthetic_fashion_mnist,
+    synthetic_mnist,
+)
+
+
+def test_mnist_shapes_and_dtypes():
+    d = synthetic_mnist(n_train=512, n_test=128, seed=0)
+    assert d["train_images"].shape == (512, 28, 28, 1)
+    assert d["train_images"].dtype == np.uint8
+    assert d["train_labels"].shape == (512,)
+    assert d["train_labels"].dtype == np.int32
+    assert d["test_images"].shape == (128, 28, 28, 1)
+    assert d["num_classes"] == 10
+    assert 0 <= d["train_labels"].min() and d["train_labels"].max() <= 9
+
+
+def test_mnist_deterministic():
+    a = synthetic_mnist(n_train=64, n_test=16, seed=3)
+    b = synthetic_mnist(n_train=64, n_test=16, seed=3)
+    np.testing.assert_array_equal(a["train_images"], b["train_images"])
+    np.testing.assert_array_equal(a["train_labels"], b["train_labels"])
+    c = synthetic_mnist(n_train=64, n_test=16, seed=4)
+    assert not np.array_equal(a["train_images"], c["train_images"])
+
+
+def test_train_test_disjoint_streams():
+    d = synthetic_mnist(n_train=64, n_test=64, seed=0)
+    assert not np.array_equal(d["train_images"][:64], d["test_images"][:64])
+
+
+def test_classes_visually_distinct():
+    """Mean image per class should differ between classes (else unlearnable)."""
+    d = synthetic_mnist(n_train=2000, n_test=10, seed=0)
+    x = d["train_images"].astype(np.float32) / 255.0
+    y = d["train_labels"]
+    means = np.stack([x[y == c].mean(axis=0) for c in range(10)])
+    dists = np.linalg.norm(
+        (means[:, None] - means[None, :]).reshape(10, 10, -1), axis=-1
+    )
+    off_diag = dists[~np.eye(10, dtype=bool)]
+    assert off_diag.min() > 1.0, "some class templates are nearly identical"
+
+
+def test_labels_roughly_balanced():
+    d = synthetic_mnist(n_train=5000, n_test=10, seed=0)
+    counts = np.bincount(d["train_labels"], minlength=10)
+    assert counts.min() > 300
+
+
+@pytest.mark.parametrize(
+    "fn,shape",
+    [
+        (synthetic_fashion_mnist, (28, 28, 1)),
+        (synthetic_cifar10, (32, 32, 3)),
+    ],
+)
+def test_other_datasets(fn, shape):
+    d = fn(n_train=128, n_test=32, seed=0)
+    assert d["train_images"].shape == (128,) + shape
+    assert d["num_classes"] == 10
+
+
+def test_load_dataset_fallback_to_synthetic():
+    d = load_dataset("mnist", n_train=64, n_test=16, seed=0)
+    assert d["train_images"].shape == (64, 28, 28, 1)
+
+
+def test_load_dataset_unknown_raises():
+    with pytest.raises(ValueError):
+        load_dataset("imagenet")

@@ -1,0 +1,56 @@
+"""End-to-end Trainer smoke tests — BASELINE.md config 1 shape (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+
+from distributed_tensorflow_ibm_mnist_tpu.core import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import PRESETS, RunConfig, get_preset
+
+
+def test_presets_cover_baseline_configs():
+    assert set(PRESETS) == {
+        "mnist_mlp_smoke",
+        "mnist_lenet_1chip",
+        "mnist_cnn_dp8",
+        "fashion_resnet20_dp32",
+        "cifar_resnet50_dp32",
+    }
+    assert get_preset("mnist_mlp_smoke").model == "mlp"
+
+
+def test_mlp_smoke_end_to_end():
+    """Config 1 (MNIST MLP, batch 32) shrunk for CI: learns well above chance."""
+    cfg = RunConfig(
+        name="smoke", model="mlp", model_kwargs={"hidden": (128,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=2048, n_test=512,
+        batch_size=32, epochs=3, lr=2e-3, dp=1, eval_every=3, quiet=True,
+    )
+    trainer = Trainer(cfg)
+    summary = trainer.fit()
+    assert summary["best_test_accuracy"] > 0.85
+    assert summary["images_per_sec"] > 0
+    assert summary["epochs_run"] == 3
+    assert trainer.history[-1]["test_accuracy"] > 0.85
+
+
+def test_trainer_dp8_end_to_end(eight_devices):
+    """Config 3 shape (DP over 8 devices) shrunk for CI."""
+    cfg = RunConfig(
+        name="dp8_smoke", model="mlp", model_kwargs={"hidden": (128,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=2048, n_test=512,
+        batch_size=256, epochs=4, lr=4e-3, dp=8, eval_every=4, quiet=True,
+    )
+    trainer = Trainer(cfg)
+    summary = trainer.fit()
+    assert summary["best_test_accuracy"] > 0.8
+
+
+def test_trainer_early_stop_on_target():
+    cfg = RunConfig(
+        name="early", model="mlp", model_kwargs={"hidden": (128,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=2048, n_test=256,
+        batch_size=64, epochs=20, lr=2e-3, dp=1,
+        target_accuracy=0.5, eval_every=1, quiet=True,
+    )
+    summary = Trainer(cfg).fit()
+    assert summary["epochs_run"] < 20
+    assert summary["time_to_target_s"] is not None
