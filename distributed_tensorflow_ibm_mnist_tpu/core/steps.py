@@ -131,8 +131,13 @@ def make_epoch_runner(
     train_step = make_train_step(model, tx, axis_name=axis_name, label_smoothing=label_smoothing)
 
     def run_epoch(state: TrainState, images: jax.Array, labels: jax.Array, epoch_rng: jax.Array):
+        # Under shard_map (axis_name set) this body sees the LOCAL shard and
+        # ``batch_size`` is the per-device batch; each device permutes its own
+        # shard with a decorrelated RNG.  Single-device, it is the global loop.
         n = images.shape[0]
         steps = n // batch_size
+        if axis_name is not None:
+            epoch_rng = jax.random.fold_in(epoch_rng, jax.lax.axis_index(axis_name))
         perm = jax.random.permutation(epoch_rng, n)[: steps * batch_size]
         perm = perm.reshape(steps, batch_size)
 

@@ -21,7 +21,7 @@ from distributed_tensorflow_ibm_mnist_tpu.core.optim import make_optimizer
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
 from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_epoch_runner, make_eval_fn
 from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
-from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model, model_accepts
 from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
     make_dp_epoch_runner,
     replicate,
@@ -52,10 +52,18 @@ class Trainer:
 
         n_train = data["train_images"].shape[0]
         self.steps_per_epoch = n_train // config.batch_size
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"batch_size {config.batch_size} exceeds training-set size {n_train}"
+            )
         total_steps = self.steps_per_epoch * config.epochs
 
+        model_kwargs = dict(config.model_kwargs)
+        if self.dp > 1 and model_accepts(config.model, "axis_name"):
+            # cross-replica BatchNorm: global-batch moments via pmean over ICI
+            model_kwargs.setdefault("axis_name", "data")
         self.model = get_model(
-            config.model, num_classes=self.num_classes, **config.model_kwargs
+            config.model, num_classes=self.num_classes, **model_kwargs
         )
         self.tx = make_optimizer(config, total_steps)
 
@@ -122,6 +130,9 @@ class Trainer:
         cfg = self.config
         if cfg.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.epochs}")
+        if cfg.resume and self._ckpt is not None and self._ckpt.latest_step() is not None:
+            step = self.restore_checkpoint()
+            self.writer.write("resume", step=step)
         chips = self.dp if self.dp > 1 else 1
         t0 = time.perf_counter()
         epoch_times: list[float] = []
@@ -158,7 +169,7 @@ class Trainer:
                 ):
                     time_to_target = time.perf_counter() - t0
             self.history.append(record)
-            self.writer.write("epoch", step=int((epoch + 1) * self.steps_per_epoch), **record)
+            self.writer.write("epoch", step=int(jax.device_get(self.state.step)), **record)
             if self._ckpt is not None and cfg.checkpoint_every and (epoch + 1) % cfg.checkpoint_every == 0:
                 self.save_checkpoint(wait=False)
             if time_to_target is not None and cfg.target_accuracy:

@@ -45,6 +45,10 @@ def build_config(argv: list[str] | None = None) -> RunConfig:
         metavar="KEY=VALUE", help="override any RunConfig field (repeatable)",
     )
     parser.add_argument(
+        "--resume", action="store_true",
+        help="restore the latest checkpoint from checkpoint_dir before training",
+    )
+    parser.add_argument(
         "--coordinator", default=None,
         help="multi-host: coordinator address for jax.distributed.initialize",
     )
@@ -60,6 +64,8 @@ def build_config(argv: list[str] | None = None) -> RunConfig:
 
     config = get_preset(args.preset) if args.preset else RunConfig()
     overrides = dict(args.overrides)
+    if args.resume:
+        overrides["resume"] = True
     unknown = set(overrides) - set(config.to_dict())
     if unknown:
         parser.error(f"unknown config fields: {sorted(unknown)}")

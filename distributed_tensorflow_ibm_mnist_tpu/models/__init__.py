@@ -36,4 +36,18 @@ def available_models() -> list[str]:
     return sorted(_REGISTRY)
 
 
-__all__ = ["MLP", "LeNet5", "ResNet", "ResNet20", "ResNet50", "get_model", "available_models"]
+def model_accepts(name: str, param: str) -> bool:
+    """Whether a registry builder takes the given keyword (e.g. axis_name)."""
+    import inspect
+
+    try:
+        builder = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}") from None
+    try:
+        return param in inspect.signature(builder).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+__all__ = ["MLP", "LeNet5", "ResNet", "ResNet20", "ResNet50", "get_model", "available_models", "model_accepts"]

@@ -20,12 +20,11 @@ The same ``train_step`` body is used single-device and N-device; only the
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
-from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_epoch_runner, make_train_step
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
 
 AXIS = "data"
@@ -90,24 +89,12 @@ def make_dp_epoch_runner(
     if global_batch % dp:
         raise ValueError(f"global batch {global_batch} not divisible by dp={dp}")
     local_batch = global_batch // dp
-    train_step = make_train_step(model, tx, axis_name=axis, label_smoothing=label_smoothing)
-
-    def local_epoch(state: TrainState, images, labels, epoch_rng):
-        # images/labels here are the LOCAL shard (shard_map body).
-        n_local = images.shape[0]
-        steps = n_local // local_batch
-        dev_rng = jax.random.fold_in(epoch_rng, jax.lax.axis_index(axis))
-        perm = jax.random.permutation(dev_rng, n_local)[: steps * local_batch]
-        perm = perm.reshape(steps, local_batch)
-
-        def body(carry, idx):
-            batch = {
-                "image": jnp.take(images, idx, axis=0),
-                "label": jnp.take(labels, idx, axis=0),
-            }
-            return train_step(carry, batch)
-
-        return jax.lax.scan(body, state, perm)
+    # Same epoch body as the single-device path (core/steps.py), instantiated
+    # with the per-device batch and the axis fold — §7 layer 4's "same
+    # train_step code single-core and N-core" criterion, kept literal.
+    local_epoch = make_epoch_runner(
+        model, tx, local_batch, axis_name=axis, label_smoothing=label_smoothing
+    )
 
     img_spec = P(axis, *([None] * 3))
     wrapped = shard_map_compat(

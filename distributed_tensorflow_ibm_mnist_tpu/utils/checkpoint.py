@@ -37,7 +37,11 @@ class CheckpointManager:
         step = int(state.step)
         # device_get so the saved tree is host numpy regardless of sharding.
         host_state = jax.device_get(state)
-        self._mgr.save(step, args=ocp.args.StandardSave(host_state))
+        # Orbax refuses (or silently skips) a step that already exists, which
+        # would drop the weights of a rerun landing on the same step — replace.
+        if step in self._mgr.all_steps():
+            self._mgr.delete(step)
+        self._mgr.save(step, args=ocp.args.StandardSave(host_state), force=True)
         if wait:
             self._mgr.wait_until_finished()
         return step

@@ -45,6 +45,7 @@ class RunConfig:
     eval_batch_size: int = 2000
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # epochs between saves; 0 = final save only (if dir set)
+    resume: bool = False  # restore latest checkpoint from checkpoint_dir before training
     metrics_path: str | None = None  # JSONL file (always also stdout unless quiet)
     quiet: bool = False  # suppress stdout metric lines (tests/benchmarks)
 

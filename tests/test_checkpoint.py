@@ -71,3 +71,32 @@ def test_trainer_resume_continues_training(tmp_path):
     # resumed trainer can keep training
     t2.fit()
     assert int(jax.device_get(t2.state.step)) > saved_step
+
+
+def test_save_at_existing_step_overwrites(tmp_path):
+    """Re-saving at the same step must not silently keep the old weights."""
+    model, tx, state = _state(seed=1)
+    mgr = CheckpointManager(str(tmp_path / "ow"))
+    mgr.save(state, wait=True)
+    bumped = jax.tree.map(lambda p: p + 1.0, state.params)
+    state2 = state.replace(params=bumped)  # same step, different weights
+    mgr.save(state2, wait=True)
+    restored = mgr.restore(state)
+    for a, b in zip(jax.tree.leaves(bumped), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_trainer_config_resume_flag(tmp_path):
+    cfg = RunConfig(
+        name="r", model="mlp", model_kwargs={"hidden": (32,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=32, epochs=1, dp=1, quiet=True,
+        checkpoint_dir=str(tmp_path / "rck"),
+    )
+    t1 = Trainer(cfg)
+    t1.fit()
+    first_step = int(jax.device_get(t1.state.step))
+    t2 = Trainer(cfg.replace(resume=True))
+    t2.fit()
+    assert int(jax.device_get(t2.state.step)) == 2 * first_step

@@ -54,3 +54,23 @@ def test_trainer_early_stop_on_target():
     summary = Trainer(cfg).fit()
     assert summary["epochs_run"] < 20
     assert summary["time_to_target_s"] is not None
+
+
+def test_batch_larger_than_dataset_raises():
+    import pytest
+
+    cfg = RunConfig(
+        model="mlp", synthetic=True, n_train=64, n_test=16, batch_size=128, quiet=True,
+    )
+    with pytest.raises(ValueError, match="exceeds training-set size"):
+        Trainer(cfg)
+
+
+def test_dp_resnet_gets_cross_replica_bn(eight_devices):
+    cfg = RunConfig(
+        model="resnet20", synthetic=True, n_train=256, n_test=64,
+        batch_size=64, epochs=1, dp=8, quiet=True,
+    )
+    t = Trainer(cfg)
+    assert t.model.axis_name == "data"
+    t.fit()  # runs: BN pmean works inside shard_map
