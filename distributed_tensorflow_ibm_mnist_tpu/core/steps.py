@@ -36,13 +36,17 @@ def _as_input(images: jax.Array) -> jax.Array:
     return images
 
 
-def make_loss_fn(model, label_smoothing: float = 0.0) -> Callable:
+def make_loss_fn(model, label_smoothing: float = 0.0, fused_xent: bool = False) -> Callable:
     """Cross-entropy loss closure over a flax model.
 
     Returns ``loss_fn(params, batch_stats, batch, dropout_rng, train)``
     -> ``(loss, (new_batch_stats, logits))``.  ``label_smoothing`` applies to
     the training loss only (eval always reports unsmoothed cross-entropy).
+    ``fused_xent`` routes the unsmoothed loss through the Pallas fused
+    softmax-xent kernel (ops/xent.py) instead of the XLA-emitted optax op.
     """
+    if fused_xent:
+        from distributed_tensorflow_ibm_mnist_tpu.ops.xent import softmax_xent_mean
 
     def loss_fn(params, batch_stats, batch: Batch, dropout_rng, train: bool = True):
         variables: dict[str, Any] = {"params": params}
@@ -66,6 +70,8 @@ def make_loss_fn(model, label_smoothing: float = 0.0) -> Callable:
                 jax.nn.one_hot(batch["label"], n_cls), label_smoothing
             )
             loss = optax.softmax_cross_entropy(logits, targets).mean()
+        elif fused_xent:
+            loss = softmax_xent_mean(logits, batch["label"])
         else:
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
         return loss, (new_stats, logits)
@@ -78,13 +84,14 @@ def make_train_step(
     tx: optax.GradientTransformation,
     axis_name: str | None = None,
     label_smoothing: float = 0.0,
+    fused_xent: bool = False,
 ):
     """Build the pure train step; ``axis_name`` enables cross-replica psum.
 
     The returned function is NOT jitted — callers jit it directly, wrap it in
     ``shard_map`` (parallel/data_parallel.py), or scan it (epoch runner).
     """
-    loss_fn = make_loss_fn(model, label_smoothing)
+    loss_fn = make_loss_fn(model, label_smoothing, fused_xent=fused_xent)
 
     def train_step(state: TrainState, batch: Batch):
         dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -121,6 +128,7 @@ def make_epoch_runner(
     batch_size: int,
     axis_name: str | None = None,
     label_smoothing: float = 0.0,
+    fused_xent: bool = False,
 ):
     """One full epoch as a single compiled call.
 
@@ -128,7 +136,9 @@ def make_epoch_runner(
     permutation, scans ``train_step`` over ``n // batch_size`` minibatches
     gathered on device, and returns ``(state, per-step stacked metrics)``.
     """
-    train_step = make_train_step(model, tx, axis_name=axis_name, label_smoothing=label_smoothing)
+    train_step = make_train_step(
+        model, tx, axis_name=axis_name, label_smoothing=label_smoothing, fused_xent=fused_xent
+    )
 
     def run_epoch(state: TrainState, images: jax.Array, labels: jax.Array, epoch_rng: jax.Array):
         # Under shard_map (axis_name set) this body sees the LOCAL shard and

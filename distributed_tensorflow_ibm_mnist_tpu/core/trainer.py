@@ -79,7 +79,7 @@ class Trainer:
             state = replicate(self.mesh, state)
             self._run_epoch = make_dp_epoch_runner(
                 self.model, self.tx, config.batch_size, self.mesh,
-                label_smoothing=config.label_smoothing,
+                label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
             )
         else:
             self.train_images = jax.device_put(data["train_images"])
@@ -87,7 +87,7 @@ class Trainer:
             self._run_epoch = jax.jit(
                 make_epoch_runner(
                     self.model, self.tx, config.batch_size,
-                    label_smoothing=config.label_smoothing,
+                    label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
                 ),
                 donate_argnums=(0,),
             )

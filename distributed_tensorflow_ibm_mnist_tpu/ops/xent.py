@@ -1,0 +1,146 @@
+"""Pallas TPU kernel: fused softmax cross-entropy (fwd + custom VJP bwd).
+
+TPU-native replacement for the reference's
+``tf.nn.softmax_cross_entropy_with_logits`` (SURVEY.md §2.1 "MNIST CNN model
+graph" row), which it consumed as a cuDNN/Eigen kernel via the TF wheel
+(SURVEY.md §2.2).  Here the whole loss — row max, exp, reduce, log, label
+gather — is one VMEM-resident Pallas kernel per (row-tile, class) block, so
+the logits are read from HBM exactly once in the forward and once in the
+backward pass.
+
+Shapes are padded to TPU tiling (rows → multiple of 8, classes → multiple of
+128) with a large-negative fill so padded classes carry ~0 probability mass.
+The public entry ``softmax_xent(logits, labels)`` returns per-example losses
+(reduce outside), differentiates via ``jax.custom_vjp``, and runs in Pallas
+interpret mode automatically on non-TPU backends so the same code path is
+exercised by the CPU test suite (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # fill for padded class columns: exp(_NEG - max) == 0
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _pad_amounts(n_rows: int, n_cols: int, row_tile: int) -> tuple[int, int]:
+    pad_r = (-n_rows) % row_tile
+    pad_c = (-n_cols) % 128
+    return pad_r, pad_c
+
+
+def _fwd_kernel(logits_ref, labels_ref, loss_ref):
+    """Per-block: loss[i] = logsumexp(logits[i]) - logits[i, labels[i]]."""
+    logits = logits_ref[:].astype(jnp.float32)
+    labels = labels_ref[:]  # (TB, 1) int32
+    row_max = jnp.max(logits, axis=-1, keepdims=True)
+    shifted = logits - row_max
+    sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+    lse = jnp.log(sumexp) + row_max  # (TB, 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    picked = jnp.sum(jnp.where(cols == labels, logits, 0.0), axis=-1, keepdims=True)
+    loss_ref[:] = lse - picked
+
+
+def _bwd_kernel(logits_ref, labels_ref, g_ref, grad_ref):
+    """grad = (softmax(logits) - onehot(labels)) * g   (per row)."""
+    logits = logits_ref[:].astype(jnp.float32)
+    labels = labels_ref[:]
+    g = g_ref[:]  # (TB, 1)
+    row_max = jnp.max(logits, axis=-1, keepdims=True)
+    exp = jnp.exp(logits - row_max)
+    probs = exp / jnp.sum(exp, axis=-1, keepdims=True)
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    onehot = (cols == labels).astype(jnp.float32)
+    grad_ref[:] = ((probs - onehot) * g).astype(grad_ref.dtype)
+
+
+def _row_tile(n_rows: int) -> int:
+    # One grid row-tile of up to 256 rows; classes always fit one block
+    # (10-class problems pad to a single 128-lane block).
+    for tile in (256, 128, 64, 32, 16, 8):
+        if n_rows % tile == 0:
+            return tile
+    return 8
+
+
+def _prepare(logits: jax.Array, labels: jax.Array, row_tile: int = 8):
+    n, c = logits.shape
+    pad_r, pad_c = _pad_amounts(n, c, row_tile)
+    if pad_r or pad_c:
+        logits = jnp.pad(logits, ((0, pad_r), (0, pad_c)), constant_values=_NEG)
+        labels = jnp.pad(labels, ((0, pad_r),))
+    tile = _row_tile(logits.shape[0])
+    return logits, labels.astype(jnp.int32)[:, None], tile
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_xent(logits, labels, interpret):
+    loss, _ = _softmax_xent_fwd(logits, labels, interpret)
+    return loss
+
+
+def _softmax_xent_fwd(logits, labels, interpret):
+    if interpret is None:
+        interpret = not _on_tpu()
+    n = logits.shape[0]
+    padded, labels2d, tile = _prepare(logits, labels)
+    np_, cp = padded.shape
+    loss = pl.pallas_call(
+        _fwd_kernel,
+        grid=(np_ // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, cp), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, 1), jnp.float32),
+        interpret=interpret,
+    )(padded, labels2d)
+    return loss[:n, 0], (logits, labels)
+
+
+def _softmax_xent_bwd(interpret, res, g):
+    if interpret is None:
+        interpret = not _on_tpu()
+    logits, labels = res
+    n, c = logits.shape
+    padded, labels2d, tile = _prepare(logits, labels)
+    np_, cp = padded.shape
+    g2d = jnp.pad(g.astype(jnp.float32), ((0, np_ - n),))[:, None]
+    grad = pl.pallas_call(
+        _bwd_kernel,
+        grid=(np_ // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, cp), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, cp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, cp), logits.dtype),
+        interpret=interpret,
+    )(padded, labels2d, g2d)
+    return grad[:n, :c], None
+
+
+_softmax_xent.defvjp(_softmax_xent_fwd, _softmax_xent_bwd)
+
+
+def softmax_xent(
+    logits: jax.Array, labels: jax.Array, interpret: bool | None = None
+) -> jax.Array:
+    """Per-example softmax cross-entropy, (N, C) x (N,) int -> (N,) float32."""
+    return _softmax_xent(logits, labels, interpret)
+
+
+def softmax_xent_mean(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean fused cross-entropy — drop-in for the optax mean-loss call."""
+    return softmax_xent(logits, labels).mean()

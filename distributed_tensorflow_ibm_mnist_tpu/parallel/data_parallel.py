@@ -50,7 +50,9 @@ def replicate(mesh: Mesh, tree):
     return jax.device_put(tree, sharding)
 
 
-def make_dp_train_step(model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0):
+def make_dp_train_step(
+    model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0, fused_xent: bool = False
+):
     """Single DP step over a batch sharded along the data axis.
 
     Semantically identical to the single-device step on the full global
@@ -58,7 +60,9 @@ def make_dp_train_step(model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing:
     gradient.  Used for per-step control flow (checkpoint-every-N, custom
     loops); the epoch runner below is the fast path.
     """
-    train_step = make_train_step(model, tx, axis_name=axis, label_smoothing=label_smoothing)
+    train_step = make_train_step(
+        model, tx, axis_name=axis, label_smoothing=label_smoothing, fused_xent=fused_xent
+    )
     img_spec = P(axis, *([None] * 3))
     wrapped = shard_map_compat(
         train_step,
@@ -76,6 +80,7 @@ def make_dp_epoch_runner(
     mesh: Mesh,
     axis: str = AXIS,
     label_smoothing: float = 0.0,
+    fused_xent: bool = False,
 ):
     """Epoch runner over a sharded dataset: one jitted shard_map per epoch.
 
@@ -93,7 +98,8 @@ def make_dp_epoch_runner(
     # with the per-device batch and the axis fold — §7 layer 4's "same
     # train_step code single-core and N-core" criterion, kept literal.
     local_epoch = make_epoch_runner(
-        model, tx, local_batch, axis_name=axis, label_smoothing=label_smoothing
+        model, tx, local_batch, axis_name=axis, label_smoothing=label_smoothing,
+        fused_xent=fused_xent,
     )
 
     img_spec = P(axis, *([None] * 3))

@@ -36,6 +36,7 @@ class RunConfig:
     weight_decay: float = 0.0
     momentum: float = 0.9
     label_smoothing: float = 0.0
+    fused_xent: bool = False  # Pallas fused softmax-xent kernel (ops/xent.py) for the train loss
     # parallelism
     dp: int = 1  # data-parallel degree; 0 => all visible devices
     # run control
