@@ -45,6 +45,12 @@ def make_loss_fn(model, label_smoothing: float = 0.0, fused_xent: bool = False) 
     ``fused_xent`` routes the unsmoothed loss through the Pallas fused
     softmax-xent kernel (ops/xent.py) instead of the XLA-emitted optax op.
     """
+    if fused_xent and label_smoothing > 0.0:
+        raise ValueError(
+            "fused_xent and label_smoothing are mutually exclusive: the Pallas "
+            "fused kernel computes the unsmoothed loss, so smoothing would "
+            "silently bypass it"
+        )
     if fused_xent:
         from distributed_tensorflow_ibm_mnist_tpu.ops.xent import softmax_xent_mean
 

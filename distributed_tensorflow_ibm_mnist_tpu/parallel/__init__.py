@@ -9,10 +9,11 @@ runs the same program (SPMD) and gradient aggregation is a ``psum`` the
 compiler schedules onto the interconnect.
 """
 
+from distributed_tensorflow_ibm_mnist_tpu.parallel import collectives
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh, shard_map_compat
 from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
     make_dp_epoch_runner,
     shard_dataset,
 )
 
-__all__ = ["make_mesh", "shard_map_compat", "make_dp_epoch_runner", "shard_dataset"]
+__all__ = ["collectives", "make_mesh", "shard_map_compat", "make_dp_epoch_runner", "shard_dataset"]

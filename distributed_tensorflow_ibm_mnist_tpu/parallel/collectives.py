@@ -1,0 +1,125 @@
+"""The distributed communication backend: XLA collectives over ICI/DCN.
+
+The reference's comm backend was two transports configured implicitly by
+ClusterSpec + device placement: gRPC parameter-server variable traffic and
+NCCL ring all-reduce among GPU workers (SURVEY.md §2.4 [B:5]).  The
+TPU-native equivalent is this module: every cross-device exchange in the
+framework goes through one of these named collectives, which XLA lowers to
+ICI transfers inside the compiled step (intra-slice) or DCN (cross-slice,
+after ``jax.distributed.initialize`` — see launch/tpu_vm.py).
+
+Mapping (reference -> here):
+
+* NCCL all-reduce of gradients      -> :func:`all_reduce_mean` / ``psum``
+* PS variable broadcast (read)      -> :func:`broadcast` (one-to-all)
+* PS sharded variable gather        -> :func:`all_gather`
+* NCCL reduce-scatter (ZeRO-style)  -> :func:`reduce_scatter`
+* ring neighbor exchange            -> :func:`ring_shift` / ``ppermute``
+  (the primitive under ring-attention sequence parallelism)
+* MoE token dispatch                -> :func:`all_to_all`
+  (expert parallelism)
+
+All functions must be called inside a ``shard_map``/``pmap`` body where
+``axis_name`` is bound.  They are thin, explicitly-named wrappers: the
+parallelism strategies build on these so that what crosses the interconnect
+is auditable in one place.  (The DP train step in core/steps.py predates
+this module and calls ``lax.pmean`` directly; its semantics are identical
+to :func:`all_reduce_mean`.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, TypeVar
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+T = TypeVar("T")
+
+
+def axis_size(axis_name: str) -> int:
+    """Number of shards along ``axis_name`` (static under tracing)."""
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    """This shard's position along ``axis_name``."""
+    return lax.axis_index(axis_name)
+
+
+def all_reduce_sum(tree: T, axis_name: str) -> T:
+    """Sum a pytree across the axis — the NCCL all-reduce replacement."""
+    return lax.psum(tree, axis_name)
+
+
+def all_reduce_mean(tree: T, axis_name: str) -> T:
+    """Mean a pytree across the axis (gradient aggregation's usual form)."""
+    return lax.pmean(tree, axis_name)
+
+
+def all_reduce_max(tree: T, axis_name: str) -> T:
+    """Elementwise max across the axis (e.g. global grad-norm clipping)."""
+    return lax.pmax(tree, axis_name)
+
+
+def all_gather(x: jax.Array, axis_name: str, axis: int = 0, tiled: bool = True) -> jax.Array:
+    """Concatenate every shard's ``x`` along ``axis``.
+
+    ``tiled=True`` concatenates (size along ``axis`` multiplies by the axis
+    size); ``tiled=False`` stacks a new leading axis instead.
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+    """Sum across shards, then leave each shard 1/N of the result.
+
+    The ZeRO-style gradient primitive (PAPERS.md [P:6]): equivalent to
+    ``psum`` followed by slicing out this shard's block of ``axis``.
+    """
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Every shard receives shard ``root``'s value (PS variable-read analog).
+
+    Implemented as a psum of the root-masked value: 1x peak memory, unlike
+    an all_gather-then-index which would materialize an (N, ...) buffer per
+    device just to keep one row.
+    """
+    masked = jnp.where(lax.axis_index(axis_name) == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ring_shift(x: T, axis_name: str, shift: int = 1) -> T:
+    """Pass ``x`` to the neighbor ``shift`` positions up the ring.
+
+    Shard i's value goes to shard ``(i + shift) % N`` via ``ppermute`` — the
+    neighbor exchange that ring attention and pipeline transfers ride; XLA
+    lowers it to nearest-neighbor ICI hops on a TPU torus.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree.map(lambda v: lax.ppermute(v, axis_name, perm), x)
+
+
+def all_to_all(x: jax.Array, axis_name: str, split_axis: int, concat_axis: int) -> jax.Array:
+    """Transpose a sharded axis: shard i sends block j to shard j.
+
+    The MoE dispatch/combine primitive: ``x``'s ``split_axis`` is cut into
+    N blocks, block j lands on shard j, received blocks concatenate along
+    ``concat_axis``.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def grad_norm_global(grads: Any, axis_name: str | None = None) -> jax.Array:
+    """L2 norm of a gradient pytree; with ``axis_name``, the TRUE global norm
+    over sharded gradients (sum-of-squares psum before the sqrt)."""
+    import optax
+
+    local = optax.global_norm(grads)
+    if axis_name is None:
+        return local
+    return jnp.sqrt(lax.psum(jnp.square(local), axis_name))
