@@ -1,0 +1,155 @@
+"""GSPMD tensor parallelism over the ``model`` mesh axis.
+
+The reference had no tensor parallelism (SURVEY.md §2.3: DP was its only
+strategy); this module is the scale-out path the TPU rebuild adds on top.
+Design follows the Mesh-TensorFlow / scaling-book recipe rather than manual
+Megatron kernels: parameters carry :class:`~jax.sharding.PartitionSpec`
+annotations over the ``model`` axis, the batch is sharded over ``data``, and
+the UNCHANGED single-device train step (core/steps.py) is jitted with those
+shardings — XLA's SPMD partitioner inserts the all-gathers/reduce-scatters
+on ICI.  Same step code at every parallelism degree; only shardings differ.
+
+Spec rules implement the Megatron alternation for MLP stacks: even layers
+column-parallel (kernel ``P(None, "model")``), odd layers row-parallel
+(``P("model", None)``), so the pair needs a single reduction between them
+and activations stay sharded across the hidden dimension.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import Batch, make_train_step
+
+SpecRule = Callable[[tuple[str, ...], Any], P]
+
+
+def _path_keys(path) -> tuple[str, ...]:
+    """Normalize a jax key-path into a tuple of plain strings."""
+    out = []
+    for k in path:
+        if hasattr(k, "key"):
+            out.append(str(k.key))
+        elif hasattr(k, "name"):
+            out.append(str(k.name))
+        elif hasattr(k, "idx"):
+            out.append(str(k.idx))
+        else:  # pragma: no cover - future key types
+            out.append(str(k))
+    return tuple(out)
+
+
+def megatron_dense_rule(axis: str = "model") -> SpecRule:
+    """Alternating column/row-parallel specs for ``dense_{i}`` stacks.
+
+    Even ``dense_i``: kernel ``P(None, axis)``, bias ``P(axis)`` (column
+    parallel — output features sharded).  Odd ``dense_i``: kernel
+    ``P(axis, None)``, bias replicated (row parallel — the following psum is
+    the block's single reduction).  Anything else (the ``logits`` head, conv
+    kernels, norm scales) stays replicated.
+    """
+
+    def rule(path: tuple[str, ...], leaf) -> P:
+        if len(path) >= 2:
+            m = re.fullmatch(r"dense_(\d+)", path[-2])
+            if m and getattr(leaf, "ndim", 0) >= 1:
+                col = int(m.group(1)) % 2 == 0
+                if path[-1] == "kernel":
+                    return P(None, axis) if col else P(axis, None)
+                if path[-1] == "bias":
+                    return P(axis) if col else P()
+        return P()
+
+    return rule
+
+
+def make_param_specs(params, rule: SpecRule):
+    """Apply a spec rule over the param tree -> congruent PartitionSpec tree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: rule(_path_keys(path), leaf), params
+    )
+
+
+def specs_like(target, params, param_specs, default: P = P()):
+    """Spec tree congruent to ``target``, reusing param specs by path suffix.
+
+    Optimizer states mirror the param tree structure inside their own
+    containers (e.g. adam's ``mu``/``nu``), so a target leaf whose key path
+    ends with a param leaf's path gets that param's spec; everything else
+    (step counts, schedules) gets ``default``.  This is how one annotated
+    param tree shards the whole TrainState, momentum buffers included —
+    sharded optimizer state is the ZeRO-style memory win (PAPERS.md [P:6])
+    for free.
+    """
+    param_paths = {
+        _path_keys(path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(param_specs)[0]
+    }
+
+    def leaf_spec(path, leaf) -> P:
+        keys = _path_keys(path)
+        for start in range(len(keys)):
+            spec = param_paths.get(keys[start:])
+            if spec is not None and getattr(leaf, "ndim", None) == len(spec):
+                return spec
+        return default
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, target)
+
+
+def state_shardings(mesh: Mesh, state: TrainState, param_specs) -> TrainState:
+    """NamedSharding tree for a full TrainState from its param spec tree."""
+    spec_tree = specs_like(state, state.params, param_specs)
+    # params subtree: take the annotated specs verbatim (not suffix-matched)
+    spec_tree = spec_tree.replace(params=param_specs)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_train_state(mesh: Mesh, state: TrainState, param_specs) -> TrainState:
+    """Place a host/replicated TrainState onto the mesh with TP shardings."""
+    return jax.device_put(state, state_shardings(mesh, state, param_specs))
+
+
+def make_tp_train_step(
+    model,
+    tx,
+    mesh: Mesh,
+    param_specs,
+    state: TrainState,
+    data_axis: str = "data",
+    label_smoothing: float = 0.0,
+    fused_xent: bool = False,
+):
+    """Jit the plain train step under combined DP x TP GSPMD shardings.
+
+    ``(state, batch) -> (state, metrics)`` where ``state`` is sharded per
+    ``param_specs`` over the ``model`` axis and the batch is sharded over
+    ``data_axis``.  No collective appears in the step body: the SPMD
+    partitioner derives the gradient all-reduce over ``data`` and the
+    activation gathers over ``model`` from the sharding constraints alone.
+    """
+    train_step = make_train_step(
+        model, tx, axis_name=None, label_smoothing=label_smoothing, fused_xent=fused_xent
+    )
+    st_shard = state_shardings(mesh, state, param_specs)
+    img_ndim = 4  # NHWC
+    batch_shard = {
+        "image": NamedSharding(mesh, P(data_axis, *([None] * (img_ndim - 1)))),
+        "label": NamedSharding(mesh, P(data_axis)),
+    }
+    metric_shard = NamedSharding(mesh, P())
+    return jax.jit(
+        train_step,
+        in_shardings=(st_shard, batch_shard),
+        out_shardings=(st_shard, {"loss": metric_shard, "accuracy": metric_shard}),
+        donate_argnums=(0,),
+    )
