@@ -14,12 +14,14 @@ from __future__ import annotations
 from distributed_tensorflow_ibm_mnist_tpu.models.lenet import LeNet5
 from distributed_tensorflow_ibm_mnist_tpu.models.mlp import MLP
 from distributed_tensorflow_ibm_mnist_tpu.models.resnet import ResNet, ResNet20, ResNet50
+from distributed_tensorflow_ibm_mnist_tpu.models.transformer import VisionTransformer
 
 _REGISTRY = {
     "mlp": MLP,
     "lenet5": LeNet5,
     "resnet20": ResNet20,
     "resnet50": ResNet50,
+    "vit": VisionTransformer,
 }
 
 

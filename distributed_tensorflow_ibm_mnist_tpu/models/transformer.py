@@ -1,0 +1,104 @@
+"""Vision Transformer classifier — the sequence-model family of the zoo.
+
+The reference's model layer was a single MNIST CNN (SURVEY.md §1 L3); the
+rebuild adds a transformer so the framework's sequence-parallel machinery
+(parallel/ring_attention.py) has a first-class consumer.  Architecture is a
+small ViT: patchify -> learned positional embedding -> pre-norm blocks
+(MHA + MLP) -> mean-pool -> linear head.
+
+Parallelism hooks:
+
+* ``attn_fn`` — drop-in attention callable ``(q, k, v) -> out`` on
+  (B, S, H, D).  ``None`` uses in-module vanilla attention; pass the result
+  of :func:`~...parallel.ring_attention.make_ring_attention` to shard the
+  sequence over the ``seq`` mesh axis (the callable is a shard_map island,
+  so this module stays ordinary GSPMD-jitted code).
+* MLP sublayers are named ``dense_0``/``dense_1``, so the Megatron
+  alternating TP rule (parallel/tensor_parallel.py) shards them over
+  ``model`` with one reduction per block.
+
+Compute in ``dtype`` (bf16 default, MXU-friendly); params and logits f32.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attn_fn: Callable | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, _ = x.shape
+        head_dim = self.dim // self.heads
+
+        h = nn.LayerNorm(dtype=self.dtype, name="norm_attn")(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
+        qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = self.attn_fn if self.attn_fn is not None else vanilla_attention
+        o = attn(q, k, v).reshape(b, s, self.dim)
+        o = nn.Dense(self.dim, dtype=self.dtype, name="proj")(o)
+        if self.dropout > 0.0:
+            o = nn.Dropout(self.dropout, deterministic=not train)(o)
+        x = x + o
+
+        h = nn.LayerNorm(dtype=self.dtype, name="norm_mlp")(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype, name="dense_0")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="dense_1")(h)
+        if self.dropout > 0.0:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    """Patch ViT over (B, H, W, C) images in [0, 1]."""
+
+    patch_size: int = 4
+    dim: int = 128
+    depth: int = 4
+    heads: int = 4
+    mlp_ratio: int = 4
+    num_classes: int = 10
+    dropout: float = 0.0
+    attn_fn: Callable | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.patch_size
+        b, h, w, c = x.shape
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch size {p}")
+        x = x.astype(self.dtype)
+        # patchify as a stride-p conv: one MXU-friendly matmul over pixels
+        x = nn.Conv(
+            self.dim, kernel_size=(p, p), strides=(p, p), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        s = (h // p) * (w // p)
+        x = x.reshape(b, s, self.dim)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, s, self.dim))
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout, attn_fn=self.attn_fn, dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
+        x = x.mean(axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)

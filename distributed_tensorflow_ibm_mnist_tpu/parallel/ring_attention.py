@@ -1,0 +1,123 @@
+"""Ring-attention sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference had no sequence dimension at all (SURVEY.md §2.3: MNIST
+classifier), so this is pure TPU-rebuild scale-out surface: attention over a
+sequence sharded across devices, with the K/V blocks rotating around the
+ring via ``ppermute`` (one nearest-neighbor ICI hop per step on a TPU
+torus) while each device's queries stay resident.  Softmax is accumulated
+online (flash-attention style running max / sum / output), so no device
+ever materializes the full S x S score matrix OR the full-sequence K/V:
+memory is O(S_local) and the N-1 permute steps overlap compute with ICI
+transfer under XLA's async collective scheduling.
+
+Composition: :func:`make_ring_attention` returns a drop-in attention
+callable that is a ``shard_map`` island — models call it from ordinary
+GSPMD-jitted code (see models/transformer.py), batch sharded over ``data``
+and sequence over ``seq``, and XLA stitches the islands together.
+
+All math runs in float32 regardless of input dtype (softmax stability on
+bf16 inputs); the output is cast back.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
+
+
+def vanilla_attention(q, k, v, causal: bool = False):
+    """Plain softmax attention, (B, S, H, D) layout — the ring's ground truth."""
+    dtype = q.dtype
+    q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v)
+    return out.astype(dtype)
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """shard_map body: local (B, S_local, H, D) shards of a sharded sequence."""
+    dtype = q.dtype
+    q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = d**-0.5
+
+    q_pos = my * s_local + jnp.arange(s_local)  # global query positions
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_update(carry_kv, src, m, l, o):
+        k_blk, v_blk = carry_kv
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        if causal:
+            k_pos = src * s_local + jnp.arange(s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]  # (S_q, S_k)
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - m_safe[..., None])  # masked entries -> exp(-inf) = 0
+        corr = jnp.exp(m - m_safe)  # first block: exp(-inf) = 0 zeroes the empty accum
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+        return m_new, l_new, o_new
+
+    def body(r, carry):
+        k_blk, v_blk, m, l, o = carry
+        src = (my - r) % n  # after r shifts we hold the block born on shard my-r
+        m, l, o = block_update((k_blk, v_blk), src, m, l, o)
+        k_blk, v_blk = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk)
+        )
+        return k_blk, v_blk, m, l, o
+
+    m0 = jnp.full((b, h, s_local), -jnp.inf)
+    l0 = jnp.zeros((b, h, s_local))
+    o0 = jnp.zeros((b, s_local, h, d))
+    # n-1 iterations rotate + accumulate; the final block needs no send.
+    k_blk, v_blk, m, l, o = lax.fori_loop(0, n - 1, body, (k, v, m0, l0, o0))
+    m, l, o = block_update((k_blk, v_blk), (my - (n - 1)) % n, m, l, o)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked queries (padding) -> 0 output
+    out = o / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    batch_axis: str | None = "data",
+    seq_axis: str = "seq",
+    causal: bool = False,
+):
+    """Build ``attn(q, k, v) -> out`` with the sequence sharded over ``seq_axis``.
+
+    The returned callable is a ``shard_map`` island over ``(batch, seq)``:
+    call it from GSPMD-jitted model code on (B, S, H, D) activations and the
+    partitioner feeds it the local shards.  With ``seq_axis`` of size 1 it
+    degrades to exactly one (vanilla) block update.
+    """
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal)
+    island = shard_map_compat(fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    b_size = mesh.shape[batch_axis] if batch_axis is not None else 1
+    s_size = mesh.shape[seq_axis]
+
+    def attn(q, k, v):
+        # Shapes are static under tracing: when they don't divide the mesh
+        # axes (model.init's batch-1 sample, tiny eval remainders), the ring
+        # is skipped for the numerically-identical dense path.
+        if q.shape[0] % b_size or q.shape[1] % s_size:
+            return vanilla_attention(q, k, v, causal=causal)
+        return island(q, k, v)
+
+    return attn
