@@ -38,21 +38,27 @@ def make_mesh(
     dp: int | None = None,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     devices: list | None = None,
 ) -> Mesh:
-    """Build a ``(data, model, seq)`` mesh over the visible devices.
+    """Build a ``(data, model, seq, pipe)`` mesh over the visible devices.
 
     ``dp=None`` uses all remaining devices for data parallelism.  Axis sizes
     must multiply to at most ``len(devices)``; trailing devices are unused.
+    Axis order puts ``data`` outermost (DCN-friendly across slices) and the
+    compute-coupled axes (``model``/``seq``/``pipe``) innermost so their
+    collectives ride adjacent ICI links.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if dp is None or dp == 0:
-        dp = n // (tp * sp)
+        dp = n // (tp * sp * pp)
         if dp == 0:
-            raise ValueError(f"tp*sp={tp * sp} exceeds device count {n}; no room for a data axis")
-    need = dp * tp * sp
+            raise ValueError(
+                f"tp*sp*pp={tp * sp * pp} exceeds device count {n}; no room for a data axis"
+            )
+    need = dp * tp * sp * pp
     if need > n:
-        raise ValueError(f"mesh ({dp}x{tp}x{sp}) needs {need} devices, have {n}")
-    arr = np.array(devices[:need]).reshape(dp, tp, sp)
-    return Mesh(arr, ("data", "model", "seq"))
+        raise ValueError(f"mesh ({dp}x{tp}x{sp}x{pp}) needs {need} devices, have {n}")
+    arr = np.array(devices[:need]).reshape(dp, tp, sp, pp)
+    return Mesh(arr, ("data", "model", "seq", "pipe"))

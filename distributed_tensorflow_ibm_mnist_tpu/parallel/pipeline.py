@@ -1,0 +1,99 @@
+"""GPipe-style pipeline parallelism over the ``pipe`` mesh axis.
+
+The reference had no pipeline parallelism (SURVEY.md §2.3); this is rebuild
+scale-out surface.  Design is the canonical SPMD pipeline, not a
+per-stage-process scheduler: every device runs the SAME program under
+``shard_map``, holding only its own stage's parameters (the stacked
+per-stage param tree is sharded over ``pipe``).  A ``lax.scan`` over
+``M + N - 1`` ticks streams M microbatches through N stages; between ticks
+each stage hands its activation to its successor with a single ``ppermute``
+hop (nearest-neighbor ICI on a TPU torus).  The whole schedule — bubbles
+included — is one compiled XLA module, and autodiff through scan+ppermute
+yields the standard GPipe backward schedule for free, so the pipeline is
+trainable with ``jax.grad`` unchanged.
+
+Memory: each device holds 1/N of the layer params and one microbatch
+activation (plus scan residuals for backward — use ``jax.checkpoint`` on
+``stage_fn`` to trade those for recompute).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel import collectives as cl
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
+
+AXIS = "pipe"
+
+
+def stack_stage_params(per_stage_params: list) -> any:
+    """Stack N congruent per-stage param trees along a new leading axis.
+
+    The result is what :func:`make_pipeline_apply` shards over ``pipe``:
+    leaf shape ``(N, ...)``, one slice per stage.
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def make_pipeline_apply(
+    stage_fn: Callable,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = AXIS,
+    remat: bool = False,
+):
+    """Build ``apply(stage_params, x) -> y`` streaming x through the stages.
+
+    * ``stage_fn(params, x) -> y`` — one stage's computation; activations
+      must keep one shape through the pipeline (equal-width stages).
+    * ``stage_params`` — stacked tree from :func:`stack_stage_params`,
+      leaf shape ``(n_stages, ...)``.
+    * ``x`` — ``(batch, ...)`` with ``batch`` divisible by ``n_microbatches``.
+
+    Returns the full-batch output, replicated over the ``pipe`` axis.
+    """
+    n_stages = mesh.shape[axis_name]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def pipelined(stage_params, x):
+        # shard_map body: stage_params leaves are (1, ...) — this shard's stage.
+        params = jax.tree.map(lambda a: a[0], stage_params)
+        idx = lax.axis_index(axis_name)
+        m = n_microbatches
+        mb = jnp.reshape(x, (m, x.shape[0] // m) + x.shape[1:])
+        ticks = m + n_stages - 1
+
+        def tick(carry, t):
+            buf, outputs = carry
+            # stage 0 ingests microbatch t (clamped once the stream runs dry);
+            # later stages consume what arrived from their predecessor.
+            inject = mb[jnp.clip(t, 0, m - 1)]
+            inp = jnp.where(idx == 0, inject, buf)
+            out = fn(params, inp)
+            # the last stage completes microbatch t-(N-1) at this tick
+            done = t - (n_stages - 1)
+            outputs = jnp.where(
+                (idx == n_stages - 1) & (done >= 0),
+                outputs.at[jnp.clip(done, 0, m - 1)].set(out),
+                outputs,
+            )
+            buf = cl.ring_shift(out, axis_name, 1)
+            return (buf, outputs), None
+
+        buf0 = jnp.zeros_like(mb[0])
+        out_sd = jax.eval_shape(fn, params, mb[0])
+        out0 = jnp.zeros((m,) + out_sd.shape, out_sd.dtype)
+        (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+        # everyone needs the result (loss/backward); fetch it off the last stage
+        outputs = cl.broadcast(outputs, axis_name, root=n_stages - 1)
+        return jnp.reshape(outputs, (x.shape[0],) + outputs.shape[2:])
+
+    return shard_map_compat(
+        pipelined, mesh, in_specs=(P(axis_name), P()), out_specs=P()
+    )

@@ -36,7 +36,7 @@ def test_mesh_axes(eight_devices):
     assert mesh.shape["data"] == 8
     assert mesh.shape["model"] == 1
     mesh2 = make_mesh(dp=4, tp=2)
-    assert mesh2.shape == {"data": 4, "model": 2, "seq": 1}
+    assert mesh2.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
 
 
 def test_dp_step_matches_single_device(eight_devices):
