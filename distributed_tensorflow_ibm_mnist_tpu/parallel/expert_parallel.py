@@ -1,0 +1,158 @@
+"""Expert parallelism: Switch-style top-1 MoE with all_to_all dispatch.
+
+The reference had no MoE (SURVEY.md §2.3); this completes the rebuild's
+parallelism-strategy inventory.  Design follows the Switch/GShard recipe,
+shaped for the MXU: routing produces a STATIC-shaped ``(tokens, experts,
+capacity)`` dispatch tensor, so dispatch and combine are two einsums (dense
+matmuls, no scatter/gather, no dynamic shapes), and expert FFNs are one
+batched matmul over the expert dimension.
+
+Distribution: with ``E`` total experts over an ``A``-way mesh axis, each
+shard owns ``E/A`` experts and routes its local tokens to ALL experts; one
+:func:`~...collectives.all_to_all` moves each expert's capacity buffers to
+the shard that owns it, the expert FFNs run, and the reverse all_to_all
+brings results home (SURVEY.md §2.4's transposing collective).  Tokens
+beyond an expert's capacity are dropped (standard Switch semantics) — size
+capacity with :func:`expert_capacity` to bound drops.
+
+Gradient path: the gate probability multiplies the combined output, so the
+router trains through the same loss (plus the standard load-balancing
+auxiliary loss, returned separately).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel import collectives as cl
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
+
+
+def expert_capacity(n_tokens: int, n_experts: int, factor: float = 1.25) -> int:
+    """Per-expert buffer size for ``n_tokens`` routed across ``n_experts``."""
+    return max(1, int(n_tokens * factor / n_experts))
+
+
+def _route(x, w_router, n_experts: int, capacity: int):
+    """Top-1 routing -> (dispatch (T,E,C), combine (T,E,C), aux_loss)."""
+    logits = x @ w_router  # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)  # (T, E)
+    # position of each token within its expert's buffer, in arrival order
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    dispatch = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), capacity)  # (T,E,C)
+    combine = dispatch * gate[:, None, None]
+    # load-balancing ingredients: fraction-of-tokens / mean-router-prob per
+    # expert (the caller reduces these across shards BEFORE the product, so
+    # the distributed aux loss is exactly the global one)
+    frac_tokens = onehot.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    return dispatch, combine, (frac_tokens, frac_probs)
+
+
+def _expert_ffn(params, x):
+    """Batched expert FFN: x (E, C, D) with per-expert stacked params."""
+    h = jnp.einsum("ecd,edh->ech", x, params["w1"]) + params["b1"][:, None]
+    h = nn.gelu(h)
+    return jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params["b2"][:, None]
+
+
+def _aux_loss(frac_tokens, frac_probs, n_experts: int):
+    """Switch load-balancing loss: E x sum(frac_tokens * frac_probs)."""
+    return n_experts * jnp.sum(frac_tokens * frac_probs)
+
+
+def moe_ffn_local(params, x, n_experts: int, capacity: int):
+    """Single-shard MoE forward: ``x`` (T, D) -> (out (T, D), aux_loss)."""
+    dispatch, combine, fracs = _route(x, params["router"], n_experts, capacity)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    expert_out = _expert_ffn(params, expert_in)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.astype(x.dtype), _aux_loss(*fracs, n_experts)
+
+
+def make_moe_dispatch(mesh: Mesh, n_experts: int, capacity: int, axis_name: str = "data"):
+    """Build the expert-parallel MoE forward as a shard_map island.
+
+    ``moe(params, x) -> (out, aux)`` where ``x`` is (T, D) sharded over
+    ``axis_name``, ``params['router']`` is replicated, and the expert-stacked
+    leaves (``w1/b1/w2/b2``, leading dim ``n_experts``) are sharded over the
+    same axis — each shard OWNS ``n_experts / axis_size`` experts.
+    ``capacity`` is per (shard, expert) pair.
+    """
+    a = mesh.shape[axis_name]
+    if n_experts % a:
+        raise ValueError(f"n_experts={n_experts} not divisible by |{axis_name}|={a}")
+
+    def local(params, x):
+        # x: local (T_local, D); expert params: local (E/A, ...) — this
+        # shard's experts.  Route locally to ALL E experts, then all_to_all
+        # so each shard runs only its own experts on everyone's tokens.
+        dispatch, combine, fracs = _route(x, params["router"], n_experts, capacity)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+        # (E, C, D) -> (E/A, A*C, D): block e of shard s lands on shard owning e
+        expert_in = cl.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=1)
+        expert_out = _expert_ffn(params, expert_in)
+        # reverse: (E/A, A*C, D) -> (E, C, D), capacity buffers back home
+        expert_out = cl.all_to_all(expert_out, axis_name, split_axis=1, concat_axis=0)
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        # global fractions first, THEN the product: exact global aux loss
+        fracs = cl.all_reduce_mean(fracs, axis_name)
+        return out.astype(x.dtype), _aux_loss(*fracs, n_experts)
+
+    param_specs = {
+        "router": P(),
+        "w1": P(axis_name), "b1": P(axis_name),
+        "w2": P(axis_name), "b2": P(axis_name),
+    }
+    return shard_map_compat(
+        local, mesh,
+        in_specs=(param_specs, P(axis_name, None)),
+        out_specs=(P(axis_name, None), P()),
+    )
+
+
+class MoEBlock(nn.Module):
+    """Drop-in MoE FFN block on (B, S, D) activations.
+
+    ``ep_fn`` (from :func:`make_moe_dispatch`) runs it expert-parallel;
+    ``None`` computes all experts locally.  Returns the block output; the
+    load-balancing aux loss is stored in the ``losses`` collection (flax
+    ``sow``) for the trainer to add.
+    """
+
+    dim: int
+    n_experts: int = 8
+    hidden_mult: int = 4
+    capacity_factor: float = 2.0
+    ep_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, d = x.shape
+        h = self.hidden_mult * self.dim
+        init = nn.initializers.lecun_normal()
+        params = {
+            "router": self.param("router", init, (d, self.n_experts)),
+            "w1": self.param("w1", init, (self.n_experts, d, h)),
+            "b1": self.param("b1", nn.initializers.zeros, (self.n_experts, h)),
+            "w2": self.param("w2", init, (self.n_experts, h, d)),
+            "b2": self.param("b2", nn.initializers.zeros, (self.n_experts, d)),
+        }
+        tokens = x.reshape(b * s, d)
+        if self.ep_fn is not None:
+            out, aux = self.ep_fn(params, tokens)
+        else:
+            cap = expert_capacity(b * s, self.n_experts, self.capacity_factor)
+            out, aux = moe_ffn_local(params, tokens, self.n_experts, cap)
+        self.sow("losses", "moe_aux", aux)
+        return out.reshape(b, s, d)
