@@ -21,6 +21,8 @@ startup), eliminating the reference's per-step feed_dict host->device copy
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 # Classic 5x7 dot-matrix digit glyphs. Each string row is one glyph row;
@@ -165,11 +167,30 @@ def _make_split(
     shift_frac: float,
     noise_std: float,
     chunk: int = 16384,
+    backend: str | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Balanced labels + rendered images, chunked to bound peak host memory."""
+    """Balanced labels + rendered images, chunked to bound peak host memory.
+
+    ``backend``: ``"numpy"`` (default) or ``"native"`` — the multithreaded
+    C++ renderer (data/native.py), same algorithm on its own per-sample RNG
+    streams (equivalent difficulty class, not bit-identical to numpy).  The
+    ``DTM_DATA_BACKEND`` env var sets the default.
+    """
     rng = np.random.default_rng(seed)
     n_classes = templates.shape[0]
     labels = rng.integers(0, n_classes, size=n).astype(np.int32)
+    if backend is None:
+        backend = os.environ.get("DTM_DATA_BACKEND", "numpy")
+    if backend == "native":
+        from distributed_tensorflow_ibm_mnist_tpu.data import native
+
+        images = native.render_affine(
+            templates, labels, out_hw, scale_range, rot_range, shift_frac,
+            noise_std, seed=seed,
+        )
+        if images is not None:
+            return images, labels
+        # no toolchain on this machine: fall through to numpy
     imgs = []
     for start in range(0, n, chunk):
         imgs.append(
