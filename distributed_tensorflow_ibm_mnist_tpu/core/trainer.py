@@ -125,8 +125,13 @@ class Trainer:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))
         return {k: float(v) for k, v in out.items()}
 
-    def fit(self) -> dict[str, Any]:
-        """Run the configured number of epochs (early-stop on target acc)."""
+    def fit(self, preemption=None) -> dict[str, Any]:
+        """Run the configured number of epochs (early-stop on target acc).
+
+        ``preemption``: an object with a ``triggered`` property (see
+        utils/elastic.PreemptionHandler) polled between epochs — when set,
+        the loop checkpoints and returns cleanly with ``preempted: True``.
+        """
         cfg = self.config
         if cfg.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.epochs}")
@@ -138,6 +143,7 @@ class Trainer:
         epoch_times: list[float] = []
         time_to_target = None
         best_acc = 0.0
+        preempted = False
 
         for epoch in range(cfg.epochs):
             epoch_rng = jax.random.fold_in(self._data_rng, epoch)
@@ -147,6 +153,23 @@ class Trainer:
             )
             metrics = jax.tree.map(lambda m: float(jnp.mean(m)), jax.device_get(metrics))
             epoch_time = time.perf_counter() - te
+            if not np.isfinite(metrics["loss"]):
+                # divergence detection (SURVEY.md §5 sanitizer analog): fail
+                # loudly, with the offending leaves localized, after letting
+                # any in-flight async checkpoint land (run_with_recovery will
+                # reopen this directory immediately)
+                from distributed_tensorflow_ibm_mnist_tpu.utils.debug import (
+                    TrainingDiverged,
+                    find_nonfinite,
+                )
+
+                if self._ckpt is not None:
+                    self._ckpt.wait()
+                raise TrainingDiverged(
+                    f"non-finite train loss in epoch {epoch}",
+                    step=int(jax.device_get(self.state.step)),
+                    bad_leaves=find_nonfinite(self.state.params),
+                )
             epoch_times.append(epoch_time)
             images = self.steps_per_epoch * cfg.batch_size
             record = {
@@ -174,6 +197,11 @@ class Trainer:
                 self.save_checkpoint(wait=False)
             if time_to_target is not None and cfg.target_accuracy:
                 break
+            if preemption is not None and preemption.triggered:
+                preempted = True
+                self.save_checkpoint(wait=True)
+                self.writer.write("preempted", step=int(jax.device_get(self.state.step)))
+                break
 
         total_time = time.perf_counter() - t0
         # First epoch includes XLA compile; steady-state rate excludes it.
@@ -191,7 +219,11 @@ class Trainer:
             "images_per_sec_per_chip": round(images / (sum(steady) / len(steady)) / chips, 1),
             "param_count": self.state.param_count() if self.dp == 1 else None,
         }
-        if self._ckpt is not None:
+        if preempted:
+            summary["preempted"] = True
+            # the preemption path already saved; re-saving the same step
+            # would delete-and-rewrite it during the SIGTERM grace window
+        if self._ckpt is not None and not preempted:
             self.save_checkpoint(wait=True)
         self.writer.write("summary", **summary)
         return summary

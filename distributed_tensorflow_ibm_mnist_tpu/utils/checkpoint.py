@@ -37,6 +37,10 @@ class CheckpointManager:
         step = int(state.step)
         # device_get so the saved tree is host numpy regardless of sharding.
         host_state = jax.device_get(state)
+        # Serialize with any in-flight async save: a same-step re-save (e.g.
+        # checkpoint_every landing on the final epoch) must not delete the
+        # directory a background write is still filling.
+        self._mgr.wait_until_finished()
         # Orbax refuses (or silently skips) a step that already exists, which
         # would drop the weights of a rerun landing on the same step — replace.
         if step in self._mgr.all_steps():
@@ -65,6 +69,10 @@ class CheckpointManager:
             jax.device_get(target),
         )
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        """Block until any in-flight async save lands."""
+        self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

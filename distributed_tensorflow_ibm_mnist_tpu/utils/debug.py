@@ -1,0 +1,89 @@
+"""Numeric-health checking and fault injection.
+
+SURVEY.md §5 row 2: the reference had no sanitizers — parameter-server
+async staleness was tolerated, not detected.  The sync-SPMD rebuild's
+analog is numeric: divergence (NaN/Inf from a bad LR, bf16 overflow, or a
+flaky interconnect hop) is the failure mode worth detecting.  This module
+provides the detector (cheap on-device finiteness reduction + per-leaf
+localization), a trainer-facing guard, and fault injection to test the
+recovery story end-to-end (utils/elastic.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised when a guarded step/state stops being finite."""
+
+    def __init__(self, message: str, step: int | None = None, bad_leaves: list[str] | None = None):
+        super().__init__(message)
+        self.step = step
+        self.bad_leaves = bad_leaves or []
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Single bool scalar: every leaf of the pytree is finite.
+
+    Jit-safe and cheap (one fused reduction); use inside compiled steps or
+    on fetched metrics alike.
+    """
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(leaves).all()
+
+
+def find_nonfinite(tree: Any) -> list[str]:
+    """Paths of leaves containing NaN/Inf ('/'-joined keys) — the localizer."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            continue
+        if not bool(jax.device_get(jnp.all(jnp.isfinite(leaf)))):
+            keys = [str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))) for k in path]
+            bad.append("/".join(keys))
+    return bad
+
+
+def check_state(state: Any, step: int | None = None) -> None:
+    """Raise :class:`TrainingDiverged` (with leaf paths) on non-finite state."""
+    if bool(jax.device_get(all_finite(state))):
+        return
+    bad = find_nonfinite(state)
+    raise TrainingDiverged(
+        f"non-finite values at step {step}: {bad[:8]}{'...' if len(bad) > 8 else ''}",
+        step=step, bad_leaves=bad,
+    )
+
+
+def inject_nan(tree: Any, leaf_path: str) -> Any:
+    """Return a copy of ``tree`` with one element of one leaf set to NaN.
+
+    ``leaf_path`` is the '/'-joined path as printed by
+    :func:`find_nonfinite`.  Fault injection for recovery tests only.
+    """
+    hit = []
+
+    def visit(path, leaf):
+        keys = "/".join(str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))) for k in path)
+        if keys == leaf_path:
+            hit.append(keys)
+            flat = jnp.ravel(leaf).at[0].set(jnp.nan)
+            return flat.reshape(leaf.shape).astype(leaf.dtype)
+        return leaf
+
+    out = jax.tree_util.tree_map_with_path(visit, tree)
+    if not hit:
+        raise KeyError(f"no leaf at path {leaf_path!r}")
+    return out
+
+
+def enable_nan_debugging() -> None:
+    """Globally re-run ops that produce NaN un-jitted for a precise traceback
+    (``jax_debug_nans``) — slow; for debugging sessions, not production."""
+    jax.config.update("jax_debug_nans", True)

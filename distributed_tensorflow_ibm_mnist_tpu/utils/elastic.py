@@ -1,0 +1,93 @@
+"""Failure detection + elastic recovery: preemption and divergence restart.
+
+SURVEY.md §5 row 3: the reference's recovery story was K8s pod restart +
+the chief's checkpoint.  TPU jobs are gang-scheduled, so the rebuild's
+story is the same shape, made explicit and testable:
+
+* :class:`PreemptionHandler` — catches SIGTERM/SIGINT (the TPU-VM
+  maintenance-event signal path) and flips a flag the training loop polls
+  between epochs; the Trainer then checkpoints and exits cleanly instead of
+  dying mid-epoch.
+* :func:`run_with_recovery` — supervision loop: build a Trainer, run it; on
+  divergence (:class:`~...debug.TrainingDiverged`) or crash, rebuild and
+  resume from the latest checkpoint, bounded by ``max_restarts``.  Note:
+  replays are deterministic (same seed, same data order), so this recovers
+  transient faults (a flaky hop, a bad host) — a divergence that is a pure
+  function of the config (bad LR) will recur and exhaust ``max_restarts``;
+  change the config, don't just restart.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Callable
+
+from distributed_tensorflow_ibm_mnist_tpu.utils.debug import TrainingDiverged
+
+
+class PreemptionHandler:
+    """Flag-on-signal; install around the training loop.
+
+    >>> with PreemptionHandler() as h:
+    ...     trainer.fit(preemption=h)   # loop polls h.triggered
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._prev: dict[int, Any] = {}
+        self._event = threading.Event()
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def trigger(self) -> None:
+        """Manual trigger (tests, external schedulers)."""
+        self._event.set()
+
+    def _handle(self, signum, frame):
+        self._event.set()
+
+    def __enter__(self) -> "PreemptionHandler":
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+
+
+def run_with_recovery(
+    make_trainer: Callable[[], Any],
+    max_restarts: int = 2,
+    on_restart: Callable[[int, BaseException], None] | None = None,
+) -> dict[str, Any]:
+    """Run ``make_trainer().fit()`` with restart-from-checkpoint supervision.
+
+    ``make_trainer`` must return a fresh Trainer whose config has a
+    ``checkpoint_dir`` (the recovery anchor) — each retry constructs a new
+    trainer with ``resume=True`` semantics forced, so it restarts from the
+    last durable step rather than from scratch.  Returns the final summary
+    with a ``restarts`` count added.
+    """
+    attempt = 0
+    while True:
+        trainer = make_trainer()
+        if attempt > 0:
+            cfg = trainer.config
+            if not cfg.checkpoint_dir:
+                raise ValueError("run_with_recovery needs checkpoint_dir to resume")
+            trainer.config = cfg.replace(resume=True)
+        try:
+            summary = trainer.fit()
+            summary["restarts"] = attempt
+            return summary
+        except (TrainingDiverged, FloatingPointError) as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            if on_restart is not None:
+                on_restart(attempt, e)

@@ -1,0 +1,99 @@
+"""Tracing / profiling: the subsystem the reference never had.
+
+SURVEY.md §5 row 1: the reference's "profiler" was ``time.time()`` around
+the loop.  TPU-native replacements here:
+
+* :func:`trace` — capture an XLA/TPU profile (view in TensorBoard's profile
+  plugin) around any code region;
+* :func:`start_server` — on-demand profiling of a live job from another
+  process (``jax.profiler``'s sampling path);
+* :class:`StepTimer` — honest step timing with ``block_until_ready``
+  fencing (async dispatch makes naive ``time.time()`` around a jitted call
+  measure only enqueue time) and warmup-aware summary stats.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed region into ``log_dir`` (TensorBoard-readable)."""
+    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_server(port: int = 9999):
+    """Start the live profiling server; returns the server object."""
+    return jax.profiler.start_server(port)
+
+
+class StepTimer:
+    """Wall-time per step with device fencing and warmup exclusion.
+
+    >>> timer = StepTimer(warmup=2)
+    >>> for batch in batches:
+    ...     with timer.step():
+    ...         state, m = train_step(state, batch)  # fenced on exit
+    >>> timer.summary(items_per_step=batch_size)
+    """
+
+    def __init__(self, warmup: int = 1):
+        self._warmup = warmup
+        self._times: list[float] = []
+        self._fence_obj: Any = None
+
+    @contextlib.contextmanager
+    def step(self, fence: Any = None):
+        """Time one step; ``fence`` (a jax array/pytree) is block-waited on
+        exit — pass the step's output; defaults to blocking all live arrays
+        via ``jax.block_until_ready`` on what the body registers with
+        :meth:`set_fence`."""
+        t0 = time.perf_counter()
+        self._fence_obj = fence
+        yield self
+        if self._fence_obj is not None:
+            jax.block_until_ready(self._fence_obj)
+        self._times.append(time.perf_counter() - t0)
+
+    def set_fence(self, obj: Any):
+        self._fence_obj = obj
+
+    @property
+    def times(self) -> list[float]:
+        """Post-warmup samples only; empty until a non-warmup step lands
+        (never silently reports compile time as steady state)."""
+        return self._times[self._warmup:]
+
+    def summary(self, items_per_step: int | None = None) -> dict[str, float]:
+        ts = np.asarray(self.times or [float("nan")])
+        out = {
+            "steps": int(len(self._times)),
+            "mean_s": float(ts.mean()),
+            "p50_s": float(np.percentile(ts, 50)),
+            "p90_s": float(np.percentile(ts, 90)),
+            "max_s": float(ts.max()),
+        }
+        if items_per_step:
+            out["items_per_sec"] = float(items_per_step / ts.mean())
+        return out
+
+
+def profile_fn(fn: Callable, *args, iters: int = 10, warmup: int = 2) -> dict[str, float]:
+    """Time a jitted callable honestly: warmup (compile) excluded, fenced."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    timer = StepTimer(warmup=0)
+    for _ in range(iters):
+        with timer.step() as t:
+            t.set_fence(fn(*args))
+    return timer.summary()

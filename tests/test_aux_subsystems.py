@@ -1,0 +1,137 @@
+"""Aux subsystems (SURVEY.md §5): profiling, divergence detection + fault
+injection, preemption, and restart-from-checkpoint recovery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils import debug as dbg
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+from distributed_tensorflow_ibm_mnist_tpu.utils.elastic import (
+    PreemptionHandler,
+    run_with_recovery,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.profiling import StepTimer, profile_fn
+
+
+def _cfg(**kw):
+    base = dict(
+        model="mlp", model_kwargs={"hidden": (32,)}, synthetic=True,
+        n_train=512, n_test=128, batch_size=64, epochs=2, dp=1, quiet=True,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+# ---- profiling ----
+
+def test_step_timer_and_profile_fn():
+    f = jax.jit(lambda x: jnp.sum(x * x))
+    x = jnp.arange(1024.0)
+    stats = profile_fn(f, x, iters=5, warmup=1)
+    assert stats["steps"] == 5
+    assert 0 < stats["mean_s"] < 5.0
+    assert stats["p90_s"] >= stats["p50_s"] >= 0
+
+    timer = StepTimer(warmup=1)
+    for _ in range(4):
+        with timer.step() as t:
+            t.set_fence(f(x))
+    s = timer.summary(items_per_step=128)
+    assert s["items_per_sec"] > 0 and len(timer.times) == 3
+
+
+# ---- debug / divergence detection ----
+
+def test_all_finite_and_find_nonfinite():
+    tree = {"a": jnp.ones((4,)), "b": {"c": jnp.zeros((2, 2))}}
+    assert bool(dbg.all_finite(tree))
+    bad = dbg.inject_nan(tree, "b/c")
+    assert not bool(dbg.all_finite(bad))
+    assert dbg.find_nonfinite(bad) == ["b/c"]
+    with pytest.raises(KeyError):
+        dbg.inject_nan(tree, "nope/missing")
+
+
+def test_check_state_raises_with_paths():
+    tree = {"w": jnp.ones((3,)), "v": jnp.ones((3,))}
+    dbg.check_state(tree, step=7)  # clean: no raise
+    bad = dbg.inject_nan(tree, "v")
+    with pytest.raises(dbg.TrainingDiverged) as ei:
+        dbg.check_state(bad, step=7)
+    assert ei.value.step == 7 and ei.value.bad_leaves == ["v"]
+
+
+def test_trainer_raises_on_divergence(tmp_path):
+    t = Trainer(_cfg(epochs=2))
+    # poison the params before the first epoch -> loss goes NaN
+    t.state = t.state.replace(params=dbg.inject_nan(t.state.params, "dense_0/kernel"))
+    with pytest.raises(dbg.TrainingDiverged):
+        t.fit()
+
+
+# ---- preemption ----
+
+def test_preemption_checkpoints_and_exits(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    t = Trainer(_cfg(epochs=5, checkpoint_dir=ckpt))
+
+    class Once:
+        # trigger after the first epoch completes
+        calls = 0
+
+        @property
+        def triggered(self):
+            Once.calls += 1
+            return Once.calls >= 1
+
+    summary = t.fit(preemption=Once())
+    assert summary["preempted"] is True
+    assert summary["epochs_run"] == 1
+    # resume picks up from the checkpoint
+    t2 = Trainer(_cfg(epochs=5, checkpoint_dir=ckpt, resume=True))
+    step = t2.restore_checkpoint()
+    assert step == t.steps_per_epoch
+
+
+def test_preemption_handler_manual_trigger():
+    with PreemptionHandler() as h:
+        assert not h.triggered
+        h.trigger()
+        assert h.triggered
+
+
+# ---- elastic recovery ----
+
+def test_run_with_recovery_resumes_after_divergence(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    attempts = []
+
+    def make_trainer():
+        t = Trainer(_cfg(epochs=3, checkpoint_dir=ckpt, checkpoint_every=1))
+        if not attempts:
+            # first attempt: poison params -> diverges in epoch 0
+            t.state = t.state.replace(
+                params=dbg.inject_nan(t.state.params, "dense_0/kernel")
+            )
+        attempts.append(1)
+        return t
+
+    summary = run_with_recovery(make_trainer, max_restarts=2)
+    assert summary["restarts"] == 1
+    assert len(attempts) == 2
+    assert summary["epochs_run"] == 3
+
+
+def test_run_with_recovery_gives_up(tmp_path):
+    ckpt = str(tmp_path / "ck")
+
+    def make_trainer():
+        t = Trainer(_cfg(epochs=2, checkpoint_dir=ckpt))
+        t.state = t.state.replace(params=dbg.inject_nan(t.state.params, "dense_0/kernel"))
+        return t
+
+    with pytest.raises(dbg.TrainingDiverged):
+        run_with_recovery(make_trainer, max_restarts=1)
