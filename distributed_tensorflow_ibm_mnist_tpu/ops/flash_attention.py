@@ -1,0 +1,272 @@
+"""Pallas TPU kernel: flash attention (fwd + custom VJP bwd).
+
+The transformer family's hot op (models/transformer.py), as a blockwise
+VMEM-resident kernel: per (batch*head, q-tile) grid cell the kernel streams
+K/V in tiles with an online-softmax accumulator, so the (S x S) score
+matrix never exists in HBM — O(S) memory against vanilla attention's O(S^2)
+— and the matmuls hit the MXU in f32 accumulation regardless of input
+dtype.  The backward pass is the standard flash recompute scheme, also in
+Pallas: probabilities are rebuilt blockwise from the saved row logsumexp,
+one kernel accumulating dK/dV over q-tiles and one accumulating dQ over
+k-tiles.
+
+Layout is (B, S, H, D) like the rest of the framework; head_dim is padded
+to the 128-lane TPU tile (cheap for the small heads of this model zoo, free
+for D >= 128).  Sequence padding is masked inside the kernels, so any S
+works.  On non-TPU backends the kernels run in Pallas interpret mode, which
+is how the CPU test suite exercises the same code path (SURVEY.md §4).
+
+Composes with sequence parallelism: ring attention
+(parallel/ring_attention.py) rotates K/V shards BETWEEN devices while this
+kernel is the natural per-shard block computation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _pick_block(n: int, target: int = 128) -> int:
+    """Largest power-of-two tile <= target dividing n (after padding, n is
+    a multiple of 8, so this always lands on >= 8... or n itself if tiny)."""
+    for b in (target, 64, 32, 16, 8):
+        if n % b == 0:
+            return b
+    return n
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k, s_real, causal, block_q):
+    # q_ref: (1, Tq, D); k_ref/v_ref: (1, S, D); outputs (1, Tq, D), (1, Tq, 1)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (Tq, D)
+    tq, d = q.shape
+    s = k_ref.shape[1]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Tq, Bk)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 1)
+        mask = k_pos < s_real
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        scores = jnp.where(mask, scores, _NEG)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot(p, v)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((tq, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((tq, 1), jnp.float32)
+    acc0 = jnp.zeros((tq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, s // block_k, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padding) rows -> 0
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                *, sm_scale, block_q, s_real, causal, block_k):
+    # grid cell: one k-tile; loop q-tiles accumulating dK/dV.
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (Bk, D)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    sq = q_ref.shape[1]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q), :]
+        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        mask = (k_pos < s_real) & (q_pos < s_real)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed probs
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        # with the scale folded into q, dK = dS^T @ q_folded directly
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, sq // block_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, sm_scale, block_k, s_real, causal, block_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (Tq, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    tq, d = q.shape
+    s = k_ref.shape[1]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 1)
+        mask = k_pos < s_real
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * sm_scale
+        return dq + jax.lax.dot(ds, k)
+
+    dq = jax.lax.fori_loop(0, s // block_k, body, jnp.zeros((tq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _pad(x, s_pad, d_pad):
+    return jnp.pad(x, ((0, 0), (0, s_pad), (0, d_pad)))
+
+
+def _prepare(q, k, v):
+    """(B, S, H, D) -> (B*H, S_pad, D_pad) plus the static real sizes."""
+    b, s, h, d = q.shape
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    q, k, v = to_bh(q), to_bh(k), to_bh(v)
+    s_pad = (-s) % 8
+    d_pad = (-d) % 128
+    if s_pad or d_pad:
+        q, k, v = (_pad(x, s_pad, d_pad) for x in (q, k, v))
+    return q, k, v, (b, s, h, d)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    if interpret is None:
+        interpret = not _on_tpu()
+    qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
+    bh, sp, dp_ = qp.shape
+    block_q = _pick_block(sp)
+    block_k = _pick_block(sp)
+    sm_scale = d**-0.5
+    kernel = partial(
+        _fwd_kernel, sm_scale=sm_scale, block_k=block_k, s_real=s,
+        causal=causal, block_q=block_q,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, sp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i: (b_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
+            jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    out_bshd = out[:, :s, :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out_bshd, (q, k, v, out_bshd, lse)
+
+
+def _flash_bwd(causal, interpret, res, g):
+    if interpret is None:
+        interpret = not _on_tpu()
+    q, k, v, out, lse = res
+    qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
+    gp, op, _, _ = _prepare(g, out, out)
+    bh, sp, dp_ = qp.shape
+    block_q = _pick_block(sp)
+    block_k = _pick_block(sp)
+    sm_scale = d**-0.5
+    # delta_i = rowsum(dO_i * O_i) — the flash-bwd correction term
+    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1, keepdims=True)
+
+    dkv = pl.pallas_call(
+        partial(_dkv_kernel, sm_scale=sm_scale, block_q=block_q, s_real=s,
+                causal=causal, block_k=block_k),
+        grid=(bh, sp // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sp, dp_), lambda b_, j: (b_, 0, 0)),      # q
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),  # k tile
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),  # v tile
+            pl.BlockSpec((1, sp, dp_), lambda b_, j: (b_, 0, 0)),      # do
+            pl.BlockSpec((1, sp, 1), lambda b_, j: (b_, 0, 0)),        # lse
+            pl.BlockSpec((1, sp, 1), lambda b_, j: (b_, 0, 0)),        # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
+            jax.ShapeDtypeStruct((bh, sp, dp_), v.dtype),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, delta)
+    dk_p, dv_p = dkv
+
+    dq_p = pl.pallas_call(
+        partial(_dq_kernel, sm_scale=sm_scale, block_k=block_k, s_real=s,
+                causal=causal, block_q=block_q),
+        grid=(bh, sp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i: (b_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse, delta)
+
+    def from_bh(x):
+        return x[:, :s, :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq_p), from_bh(dk_p), from_bh(dv_p)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = False, interpret: bool | None = None,
+) -> jax.Array:
+    """Blockwise (flash) attention on (B, S, H, D); drop-in ``attn_fn`` for
+    models/transformer.py.  ``interpret=None`` auto-selects interpret mode
+    off-TPU."""
+    return _flash(q, k, v, causal, interpret)

@@ -1,0 +1,86 @@
+"""Pallas flash attention vs. vanilla ground truth (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_attention
+from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
+
+
+def _qkv(b=2, s=32, h=2, d=16, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, s, h, d)).astype(dtype)) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [32, 40])  # 40: exercises sequence padding+mask
+def test_forward_matches_vanilla(causal, s):
+    q, k, v = _qkv(s=s)
+    got = flash_attention(q, k, v, causal=causal)
+    want = vanilla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_vanilla(causal):
+    q, k, v = _qkv(s=24, seed=1)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_van(q, k, v):
+        return jnp.sum(vanilla_attention(q, k, v, causal=causal) ** 2)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_v = jax.grad(loss_van, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_f, g_v):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, err_msg=f"d{name}"
+        )
+
+
+def test_bf16_io():
+    q, k, v = _qkv(seed=2)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got = flash_attention(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    want = vanilla_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+    )
+
+
+def test_jit_and_large_head():
+    q, k, v = _qkv(b=1, s=16, h=1, d=128, seed=3)  # d=128: no pad path
+    got = jax.jit(lambda a, b, c: flash_attention(a, b, c))(q, k, v)
+    want = vanilla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_as_vit_attn_fn():
+    """flash_attention drops into the transformer as attn_fn."""
+    import optax
+
+    from distributed_tensorflow_ibm_mnist_tpu.core import TrainState, make_train_step
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+
+    kw = dict(patch_size=7, dim=32, depth=1, heads=2, num_classes=10, dtype=jnp.float32)
+    vit_flash = get_model("vit", attn_fn=flash_attention, **kw)
+    vit_plain = get_model("vit", **kw)
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((1, 28, 28, 1), jnp.uint8)
+    state = TrainState.create(vit_plain, tx, jax.random.PRNGKey(0), sample)
+    rng = np.random.default_rng(4)
+    batch = {
+        "image": jnp.asarray(rng.integers(0, 255, size=(8, 28, 28, 1), dtype=np.uint8)),
+        "label": jnp.asarray(rng.integers(0, 10, size=(8,)).astype(np.int32)),
+    }
+    s1, m1 = jax.jit(make_train_step(vit_plain, tx))(state, batch)
+    s2, m2 = jax.jit(make_train_step(vit_flash, tx))(state, batch)
+    np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
