@@ -72,7 +72,36 @@ class Trainer:
         sample = jnp.zeros((1,) + data["train_images"].shape[1:], jnp.uint8)
         state = TrainState.create(self.model, self.tx, state_rng, sample)
 
-        if self.dp > 1:
+        if config.input_mode not in ("device", "stream"):
+            raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
+        self._stream = config.input_mode == "stream"
+        if self._stream:
+            # host-resident dataset (HBM holds only the in-flight batches);
+            # batches are assembled by the C++ prefetcher (data/native.py,
+            # numpy fallback) and fed to a per-step compiled train step
+            self.train_images = np.ascontiguousarray(data["train_images"])
+            self.train_labels = np.ascontiguousarray(data["train_labels"], np.int32)
+            if self.dp > 1:
+                from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+                    make_dp_train_step,
+                )
+
+                state = replicate(self.mesh, state)
+                self._train_step = make_dp_train_step(
+                    self.model, self.tx, self.mesh,
+                    label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+                )
+            else:
+                from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
+
+                self._train_step = jax.jit(
+                    make_train_step(
+                        self.model, self.tx,
+                        label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+                    ),
+                    donate_argnums=(0,),
+                )
+        elif self.dp > 1:
             self.train_images, self.train_labels = shard_dataset(
                 self.mesh, data["train_images"], data["train_labels"]
             )
@@ -121,6 +150,30 @@ class Trainer:
         self.state = restored
         return int(jax.device_get(self.state.step))
 
+    def _run_epoch_stream(self, state, epoch_rng):
+        """One epoch in stream mode: C++-prefetched host batches -> per-step
+        compiled train step.  Metrics stay device-side until epoch end so the
+        dispatch pipeline never blocks on a host readback."""
+        from distributed_tensorflow_ibm_mnist_tpu.data.native import Prefetcher
+
+        cfg = self.config
+        n = self.train_images.shape[0]
+        seed = int(jax.device_get(jax.random.randint(epoch_rng, (), 0, 2**31 - 1)))
+        perm = np.random.default_rng(seed).permutation(n)[
+            : self.steps_per_epoch * cfg.batch_size
+        ].astype(np.int32)
+        ms = []
+        with Prefetcher(
+            self.train_images, self.train_labels, cfg.batch_size, perm,
+            depth=cfg.prefetch_depth,
+        ) as pf:
+            for img, lab in pf:
+                batch = {"image": jnp.asarray(img), "label": jnp.asarray(lab)}
+                state, m = self._train_step(state, batch)
+                ms.append(m)
+        metrics = {k: jnp.stack([m[k] for m in ms]) for k in ms[0]}
+        return state, metrics
+
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))
         return {k: float(v) for k, v in out.items()}
@@ -148,9 +201,12 @@ class Trainer:
         for epoch in range(cfg.epochs):
             epoch_rng = jax.random.fold_in(self._data_rng, epoch)
             te = time.perf_counter()
-            self.state, metrics = self._run_epoch(
-                self.state, self.train_images, self.train_labels, epoch_rng
-            )
+            if self._stream:
+                self.state, metrics = self._run_epoch_stream(self.state, epoch_rng)
+            else:
+                self.state, metrics = self._run_epoch(
+                    self.state, self.train_images, self.train_labels, epoch_rng
+                )
             metrics = jax.tree.map(lambda m: float(jnp.mean(m)), jax.device_get(metrics))
             epoch_time = time.perf_counter() - te
             if not np.isfinite(metrics["loss"]):

@@ -37,6 +37,10 @@ class RunConfig:
     momentum: float = 0.9
     label_smoothing: float = 0.0
     fused_xent: bool = False  # Pallas fused softmax-xent kernel (ops/xent.py) for the train loss
+    # input pipeline
+    input_mode: str = "device"  # device: dataset HBM-resident, scan epochs;
+    #                             stream: host-resident, C++-prefetched per-step batches
+    prefetch_depth: int = 3  # stream mode: batches assembled ahead of the consumer
     # parallelism
     dp: int = 1  # data-parallel degree; 0 => all visible devices
     # run control
