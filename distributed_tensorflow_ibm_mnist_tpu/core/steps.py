@@ -36,7 +36,9 @@ def _as_input(images: jax.Array) -> jax.Array:
     return images
 
 
-def make_loss_fn(model, label_smoothing: float = 0.0, fused_xent: bool = False) -> Callable:
+def make_loss_fn(
+    model, label_smoothing: float = 0.0, fused_xent: bool = False, remat: bool = False
+) -> Callable:
     """Cross-entropy loss closure over a flax model.
 
     Returns ``loss_fn(params, batch_stats, batch, dropout_rng, train)``
@@ -44,6 +46,9 @@ def make_loss_fn(model, label_smoothing: float = 0.0, fused_xent: bool = False) 
     the training loss only (eval always reports unsmoothed cross-entropy).
     ``fused_xent`` routes the unsmoothed loss through the Pallas fused
     softmax-xent kernel (ops/xent.py) instead of the XLA-emitted optax op.
+    ``remat`` wraps the forward in ``jax.checkpoint`` — activations are
+    recomputed in the backward pass instead of stored, trading ~33% more
+    FLOPs for O(depth) less HBM (the deep-model/long-sequence lever).
     """
     if fused_xent and label_smoothing > 0.0:
         raise ValueError(
@@ -54,7 +59,7 @@ def make_loss_fn(model, label_smoothing: float = 0.0, fused_xent: bool = False) 
     if fused_xent:
         from distributed_tensorflow_ibm_mnist_tpu.ops.xent import softmax_xent_mean
 
-    def loss_fn(params, batch_stats, batch: Batch, dropout_rng, train: bool = True):
+    def forward(params, batch_stats, image, dropout_rng, train: bool):
         variables: dict[str, Any] = {"params": params}
         has_stats = bool(batch_stats)
         if has_stats:
@@ -64,12 +69,16 @@ def make_loss_fn(model, label_smoothing: float = 0.0, fused_xent: bool = False) 
             kwargs["rngs"] = {"dropout": dropout_rng}
         if has_stats and train:
             logits, updated = model.apply(
-                variables, _as_input(batch["image"]), mutable=["batch_stats"], **kwargs
+                variables, _as_input(image), mutable=["batch_stats"], **kwargs
             )
-            new_stats = updated["batch_stats"]
-        else:
-            logits = model.apply(variables, _as_input(batch["image"]), **kwargs)
-            new_stats = batch_stats
+            return logits, updated["batch_stats"]
+        return model.apply(variables, _as_input(image), **kwargs), batch_stats
+
+    if remat:
+        forward = jax.checkpoint(forward, static_argnums=(4,))
+
+    def loss_fn(params, batch_stats, batch: Batch, dropout_rng, train: bool = True):
+        logits, new_stats = forward(params, batch_stats, batch["image"], dropout_rng, train)
         if train and label_smoothing > 0.0:
             n_cls = logits.shape[-1]
             targets = optax.smooth_labels(
@@ -91,13 +100,20 @@ def make_train_step(
     axis_name: str | None = None,
     label_smoothing: float = 0.0,
     fused_xent: bool = False,
+    remat: bool = False,
+    grad_accum: int = 1,
 ):
     """Build the pure train step; ``axis_name`` enables cross-replica psum.
+
+    ``grad_accum > 1`` splits the batch into that many microbatches scanned
+    sequentially, gradients averaged before the single optimizer update —
+    numerically a ``grad_accum``-times-larger batch in 1/``grad_accum`` the
+    activation memory (composes with ``remat`` for the full memory lever).
 
     The returned function is NOT jitted — callers jit it directly, wrap it in
     ``shard_map`` (parallel/data_parallel.py), or scan it (epoch runner).
     """
-    loss_fn = make_loss_fn(model, label_smoothing, fused_xent=fused_xent)
+    loss_fn = make_loss_fn(model, label_smoothing, fused_xent=fused_xent, remat=remat)
 
     def train_step(state: TrainState, batch: Batch):
         dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -105,10 +121,35 @@ def make_train_step(
             # decorrelate dropout masks across replicas (state.rng is replicated)
             dropout_rng = jax.random.fold_in(dropout_rng, jax.lax.axis_index(axis_name))
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (new_stats, logits)), grads = grad_fn(
-            state.params, state.batch_stats, batch, dropout_rng
-        )
-        accuracy = jnp.mean(logits.argmax(-1) == batch["label"])
+        if grad_accum == 1:
+            (loss, (new_stats, logits)), grads = grad_fn(
+                state.params, state.batch_stats, batch, dropout_rng
+            )
+            accuracy = jnp.mean(logits.argmax(-1) == batch["label"])
+        else:
+            n = batch["label"].shape[0]
+            if n % grad_accum:
+                raise ValueError(f"batch size {n} not divisible by grad_accum={grad_accum}")
+            micro = jax.tree.map(
+                lambda x: x.reshape((grad_accum, n // grad_accum) + x.shape[1:]), batch
+            )
+
+            def accum(carry, xs):
+                stats, g_sum, loss_sum, acc_sum, i = carry
+                rng_i = jax.random.fold_in(dropout_rng, i)
+                (l, (stats, logits)), g = grad_fn(state.params, stats, xs, rng_i)
+                a = jnp.mean(logits.argmax(-1) == xs["label"])
+                g_sum = jax.tree.map(jnp.add, g_sum, g)
+                return (stats, g_sum, loss_sum + l, acc_sum + a, i + 1), None
+
+            g0 = jax.tree.map(jnp.zeros_like, state.params)
+            zero = jnp.zeros((), jnp.float32)
+            (new_stats, g_sum, loss_sum, acc_sum, _), _ = jax.lax.scan(
+                accum, (state.batch_stats, g0, zero, zero, jnp.zeros((), jnp.int32)), micro
+            )
+            grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
+            loss = loss_sum / grad_accum
+            accuracy = acc_sum / grad_accum
         if axis_name is not None:
             # The NCCL-all-reduce replacement: one fused cross-replica mean
             # over the ICI mesh axis, inside the compiled step.
@@ -135,6 +176,8 @@ def make_epoch_runner(
     axis_name: str | None = None,
     label_smoothing: float = 0.0,
     fused_xent: bool = False,
+    remat: bool = False,
+    grad_accum: int = 1,
 ):
     """One full epoch as a single compiled call.
 
@@ -143,7 +186,8 @@ def make_epoch_runner(
     gathered on device, and returns ``(state, per-step stacked metrics)``.
     """
     train_step = make_train_step(
-        model, tx, axis_name=axis_name, label_smoothing=label_smoothing, fused_xent=fused_xent
+        model, tx, axis_name=axis_name, label_smoothing=label_smoothing,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
 
     def run_epoch(state: TrainState, images: jax.Array, labels: jax.Array, epoch_rng: jax.Array):

@@ -90,6 +90,7 @@ class Trainer:
                 self._train_step = make_dp_train_step(
                     self.model, self.tx, self.mesh,
                     label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+                    remat=config.remat, grad_accum=config.grad_accum,
                 )
             else:
                 from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
@@ -98,6 +99,7 @@ class Trainer:
                     make_train_step(
                         self.model, self.tx,
                         label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+                    remat=config.remat, grad_accum=config.grad_accum,
                     ),
                     donate_argnums=(0,),
                 )
@@ -109,6 +111,7 @@ class Trainer:
             self._run_epoch = make_dp_epoch_runner(
                 self.model, self.tx, config.batch_size, self.mesh,
                 label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+                    remat=config.remat, grad_accum=config.grad_accum,
             )
         else:
             self.train_images = jax.device_put(data["train_images"])
@@ -117,6 +120,7 @@ class Trainer:
                 make_epoch_runner(
                     self.model, self.tx, config.batch_size,
                     label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+                    remat=config.remat, grad_accum=config.grad_accum,
                 ),
                 donate_argnums=(0,),
             )

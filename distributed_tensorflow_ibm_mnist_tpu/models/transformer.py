@@ -30,12 +30,28 @@ import jax.numpy as jnp
 from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
 
 
+def _resolve_attn(attn_fn: Callable | None, attn: str) -> Callable:
+    """attn_fn (explicit callable, e.g. a ring-attention island) wins; else
+    pick by name: 'vanilla' (XLA) or 'flash' (the Pallas kernel) — a string
+    so RunConfig/CLI can select it (``--set model_kwargs={'attn':'flash'}``)."""
+    if attn_fn is not None:
+        return attn_fn
+    if attn == "flash":
+        from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention
+    if attn == "vanilla":
+        return vanilla_attention
+    raise ValueError(f"unknown attn {attn!r}; use 'vanilla' or 'flash'")
+
+
 class TransformerBlock(nn.Module):
     dim: int
     heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
     attn_fn: Callable | None = None
+    attn: str = "vanilla"
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -47,8 +63,7 @@ class TransformerBlock(nn.Module):
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
         qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = self.attn_fn if self.attn_fn is not None else vanilla_attention
-        o = attn(q, k, v).reshape(b, s, self.dim)
+        o = _resolve_attn(self.attn_fn, self.attn)(q, k, v).reshape(b, s, self.dim)
         o = nn.Dense(self.dim, dtype=self.dtype, name="proj")(o)
         if self.dropout > 0.0:
             o = nn.Dropout(self.dropout, deterministic=not train)(o)
@@ -74,6 +89,7 @@ class VisionTransformer(nn.Module):
     num_classes: int = 10
     dropout: float = 0.0
     attn_fn: Callable | None = None
+    attn: str = "vanilla"
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -95,8 +111,8 @@ class VisionTransformer(nn.Module):
         for i in range(self.depth):
             x = TransformerBlock(
                 dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
-                dropout=self.dropout, attn_fn=self.attn_fn, dtype=self.dtype,
-                name=f"block_{i}",
+                dropout=self.dropout, attn_fn=self.attn_fn, attn=self.attn,
+                dtype=self.dtype, name=f"block_{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = x.mean(axis=1)

@@ -51,7 +51,8 @@ def replicate(mesh: Mesh, tree):
 
 
 def make_dp_train_step(
-    model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0, fused_xent: bool = False
+    model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0,
+    fused_xent: bool = False, remat: bool = False, grad_accum: int = 1,
 ):
     """Single DP step over a batch sharded along the data axis.
 
@@ -61,7 +62,8 @@ def make_dp_train_step(
     loops); the epoch runner below is the fast path.
     """
     train_step = make_train_step(
-        model, tx, axis_name=axis, label_smoothing=label_smoothing, fused_xent=fused_xent
+        model, tx, axis_name=axis, label_smoothing=label_smoothing,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
     img_spec = P(axis, *([None] * 3))
     wrapped = shard_map_compat(
@@ -81,6 +83,8 @@ def make_dp_epoch_runner(
     axis: str = AXIS,
     label_smoothing: float = 0.0,
     fused_xent: bool = False,
+    remat: bool = False,
+    grad_accum: int = 1,
 ):
     """Epoch runner over a sharded dataset: one jitted shard_map per epoch.
 
@@ -99,7 +103,7 @@ def make_dp_epoch_runner(
     # train_step code single-core and N-core" criterion, kept literal.
     local_epoch = make_epoch_runner(
         model, tx, local_batch, axis_name=axis, label_smoothing=label_smoothing,
-        fused_xent=fused_xent,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
 
     img_spec = P(axis, *([None] * 3))

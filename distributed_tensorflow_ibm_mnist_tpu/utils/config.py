@@ -37,6 +37,8 @@ class RunConfig:
     momentum: float = 0.9
     label_smoothing: float = 0.0
     fused_xent: bool = False  # Pallas fused softmax-xent kernel (ops/xent.py) for the train loss
+    grad_accum: int = 1  # microbatches per step (gradient accumulation)
+    remat: bool = False  # jax.checkpoint the forward: recompute activations in bwd
     # input pipeline
     input_mode: str = "device"  # device: dataset HBM-resident, scan epochs;
     #                             stream: host-resident, C++-prefetched per-step batches

@@ -1,0 +1,91 @@
+"""Gradient accumulation and rematerialization semantics.
+
+grad_accum=k over a batch must equal the single-shot step on the same batch
+(mean-of-microbatch-gradients == full-batch gradient for mean losses); remat
+must change memory behavior only, never numerics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.core import TrainState, make_train_step
+from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+
+def _setup(model_name="mlp", **model_kw):
+    model = get_model(model_name, num_classes=10, dtype=jnp.float32, **model_kw)
+    tx = optax.sgd(0.1)
+    state = TrainState.create(
+        model, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1), jnp.uint8)
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.integers(0, 255, size=(32, 28, 28, 1), dtype=np.uint8)),
+        "label": jnp.asarray(rng.integers(0, 10, size=(32,)).astype(np.int32)),
+    }
+    return model, tx, state, batch
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_grad_accum_matches_single_shot(accum):
+    model, tx, state, batch = _setup(hidden=(32,))
+    s1, m1 = jax.jit(make_train_step(model, tx))(state, batch)
+    sk, mk = jax.jit(make_train_step(model, tx, grad_accum=accum))(state, batch)
+    np.testing.assert_allclose(float(mk["loss"]), float(m1["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(mk["accuracy"]), float(m1["accuracy"]), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(sk.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_grad_accum_batchnorm_model():
+    """ResNet (BatchNorm): accum path must thread stats through microbatches."""
+    model, tx, state, batch = _setup("resnet20")
+    sk, mk = jax.jit(make_train_step(model, tx, grad_accum=2))(state, batch)
+    assert np.isfinite(float(mk["loss"]))
+    assert int(sk.step) == 1
+    # stats actually updated
+    a = jax.tree.leaves(state.batch_stats)[0]
+    b = jax.tree.leaves(sk.batch_stats)[0]
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_grad_accum_indivisible_rejected():
+    model, tx, state, batch = _setup(hidden=(32,))
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(make_train_step(model, tx, grad_accum=5))(state, batch)
+
+
+def test_remat_identical_numerics():
+    model, tx, state, batch = _setup(hidden=(64, 64))
+    s1, m1 = jax.jit(make_train_step(model, tx))(state, batch)
+    s2, m2 = jax.jit(make_train_step(model, tx, remat=True))(state, batch)
+    np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_trainer_with_accum_and_remat():
+    cfg = RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, synthetic=True,
+        n_train=512, n_test=128, batch_size=64, epochs=2, quiet=True,
+        grad_accum=2, remat=True,
+    )
+    summary = Trainer(cfg).fit()
+    assert summary["epochs_run"] == 2
+    assert np.isfinite(summary["best_test_accuracy"])
+
+
+def test_vit_flash_by_name():
+    """attn='flash' via model_kwargs (config/CLI path) trains."""
+    cfg = RunConfig(
+        model="vit",
+        model_kwargs={"patch_size": 7, "dim": 32, "depth": 1, "heads": 2, "attn": "flash"},
+        synthetic=True, n_train=256, n_test=64, batch_size=64, epochs=1, quiet=True,
+    )
+    summary = Trainer(cfg).fit()
+    assert np.isfinite(summary["best_test_accuracy"])
