@@ -37,7 +37,11 @@ def _as_input(images: jax.Array) -> jax.Array:
 
 
 def make_loss_fn(
-    model, label_smoothing: float = 0.0, fused_xent: bool = False, remat: bool = False
+    model,
+    label_smoothing: float = 0.0,
+    fused_xent: bool = False,
+    remat: bool = False,
+    moe_aux_weight: float = 0.01,
 ) -> Callable:
     """Cross-entropy loss closure over a flax model.
 
@@ -67,18 +71,21 @@ def make_loss_fn(
         kwargs: dict[str, Any] = {"train": train}
         if train:
             kwargs["rngs"] = {"dropout": dropout_rng}
-        if has_stats and train:
-            logits, updated = model.apply(
-                variables, _as_input(image), mutable=["batch_stats"], **kwargs
-            )
-            return logits, updated["batch_stats"]
-        return model.apply(variables, _as_input(image), **kwargs), batch_stats
+        # "losses" collects sown auxiliary losses (MoE load-balancing); it is
+        # empty for non-MoE models at zero cost
+        mutable = ["losses"] + (["batch_stats"] if has_stats and train else [])
+        logits, updated = model.apply(variables, _as_input(image), mutable=mutable, **kwargs)
+        new_stats = updated.get("batch_stats", batch_stats)
+        aux = sum(jnp.sum(v) for v in jax.tree.leaves(updated.get("losses", {})))
+        return logits, new_stats, jnp.asarray(aux, jnp.float32)
 
     if remat:
         forward = jax.checkpoint(forward, static_argnums=(4,))
 
     def loss_fn(params, batch_stats, batch: Batch, dropout_rng, train: bool = True):
-        logits, new_stats = forward(params, batch_stats, batch["image"], dropout_rng, train)
+        logits, new_stats, aux = forward(
+            params, batch_stats, batch["image"], dropout_rng, train
+        )
         if train and label_smoothing > 0.0:
             n_cls = logits.shape[-1]
             targets = optax.smooth_labels(
@@ -89,6 +96,8 @@ def make_loss_fn(
             loss = softmax_xent_mean(logits, batch["label"])
         else:
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
+        if train:
+            loss = loss + moe_aux_weight * aux
         return loss, (new_stats, logits)
 
     return loss_fn

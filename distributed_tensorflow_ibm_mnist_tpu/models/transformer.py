@@ -52,6 +52,10 @@ class TransformerBlock(nn.Module):
     dropout: float = 0.0
     attn_fn: Callable | None = None
     attn: str = "vanilla"
+    use_moe: bool = False
+    n_experts: int = 8
+    moe_capacity_factor: float = 2.0
+    moe_fn: Callable | None = None  # expert-parallel dispatch island (make_moe_dispatch)
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -70,9 +74,17 @@ class TransformerBlock(nn.Module):
         x = x + o
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_mlp")(x)
-        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype, name="dense_0")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.dim, dtype=self.dtype, name="dense_1")(h)
+        if self.use_moe:
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import MoEBlock
+
+            h = MoEBlock(
+                dim=self.dim, n_experts=self.n_experts, hidden_mult=self.mlp_ratio,
+                capacity_factor=self.moe_capacity_factor, ep_fn=self.moe_fn, name="moe",
+            )(h, train=train)
+        else:
+            h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype, name="dense_0")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.dim, dtype=self.dtype, name="dense_1")(h)
         if self.dropout > 0.0:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
@@ -90,6 +102,10 @@ class VisionTransformer(nn.Module):
     dropout: float = 0.0
     attn_fn: Callable | None = None
     attn: str = "vanilla"
+    moe_every: int = 0  # 0 = dense; k = every k-th block uses a MoE FFN
+    n_experts: int = 8
+    moe_capacity_factor: float = 2.0
+    moe_fn: Callable | None = None
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -112,7 +128,9 @@ class VisionTransformer(nn.Module):
             x = TransformerBlock(
                 dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout, attn_fn=self.attn_fn, attn=self.attn,
-                dtype=self.dtype, name=f"block_{i}",
+                use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
+                n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
+                moe_fn=self.moe_fn, dtype=self.dtype, name=f"block_{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = x.mean(axis=1)
