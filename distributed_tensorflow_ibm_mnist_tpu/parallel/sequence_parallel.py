@@ -1,0 +1,86 @@
+"""Ulysses-style all-to-all sequence/context parallelism over ``seq``.
+
+The second of the framework's two long-context strategies (the brief's
+"ring attention or all-to-all sequence parallelism"; the reference itself
+had no sequence axis at all, SURVEY.md §2.3).  Where ring attention
+(parallel/ring_attention.py) keeps the sequence sharded and rotates K/V
+around the ``seq`` ring, the Ulysses layout re-shards *heads* instead:
+
+    (B, S/n, H, D)  --all_to_all-->  (B, S, H/n, D)
+        attention over the FULL sequence for this device's H/n heads
+    (B, S, H/n, D)  --all_to_all-->  (B, S/n, H, D)
+
+Two all-to-alls per attention call (O(S·H·D/n) bytes each, ridden over ICI)
+buy a completely *local* attention inner loop — so any single-device kernel
+(the Pallas flash attention in ops/flash_attention.py, or the vanilla
+reference path) drops in unchanged via ``inner_attn``.  Trade-off vs the
+ring: Ulysses needs ``H % n == 0`` and moves activations twice, but wins
+when the inner kernel matters (flash) or when n is small relative to heads;
+the ring scales past H devices and overlaps transfer with compute.  Both
+are drop-in ``attn_fn`` islands for the model zoo (models/transformer.py),
+so the choice is one config string.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
+from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, inner_attn: Callable):
+    """shard_map body: (B, S_local, H, D) shards -> head-sharded full-seq attn."""
+    # seq-sharded -> head-sharded: split heads (axis 2) across the mesh axis,
+    # gather the full sequence (axis 1).
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    q_h, k_h, v_h = to_heads(q), to_heads(k), to_heads(v)  # (B, S, H/n, D)
+    out = inner_attn(q_h, k_h, v_h, causal=causal)
+    # head-sharded -> seq-sharded: inverse transpose.
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    batch_axis: str | None = "data",
+    seq_axis: str = "seq",
+    causal: bool = False,
+    inner_attn: Callable = vanilla_attention,
+):
+    """Build ``attn(q, k, v) -> out`` with sequence sharded over ``seq_axis``.
+
+    Same contract as :func:`~..ring_attention.make_ring_attention`: a
+    ``shard_map`` island called from GSPMD-jitted model code on (B, S, H, D)
+    activations.  ``inner_attn(q, k, v, causal=...)`` runs on the full
+    sequence with this device's head slice — pass the Pallas flash kernel
+    here for the fused path.  Falls back to the dense single-device path
+    when shapes don't divide the mesh axes (init samples, eval remainders)
+    or when heads don't divide the ``seq`` axis size.
+    """
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = functools.partial(
+        _ulysses_local, axis_name=seq_axis, causal=causal, inner_attn=inner_attn
+    )
+    island = shard_map_compat(fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    b_size = mesh.shape[batch_axis] if batch_axis is not None else 1
+    s_size = mesh.shape[seq_axis]
+
+    def attn(q, k, v):
+        divisible = (
+            q.shape[0] % b_size == 0
+            and q.shape[1] % s_size == 0
+            and q.shape[2] % s_size == 0  # heads split across the seq axis
+        )
+        if not divisible:
+            # same inner kernel as the sharded path, just unsharded — the
+            # implementation must not silently switch with the shape
+            return inner_attn(q, k, v, causal=causal)
+        return island(q, k, v)
+
+    return attn
