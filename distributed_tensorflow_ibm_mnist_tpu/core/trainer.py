@@ -196,82 +196,119 @@ class Trainer:
             step = self.restore_checkpoint()
             self.writer.write("resume", step=step)
         chips = self.dp if self.dp > 1 else 1
+        # Step base for metric records: nonzero after a checkpoint resume
+        # (the epoch counter restarts at 0 but state.step does not).
+        step0 = int(jax.device_get(self.state.step))
         t0 = time.perf_counter()
         epoch_times: list[float] = []
         time_to_target = None
         best_acc = 0.0
         preempted = False
 
+        # Epoch metrics stay on device between eval boundaries and are
+        # fetched in ONE transfer per interval: a per-epoch blocking readback
+        # would serialize the dispatch pipeline on host<->device latency (the
+        # epoch-granular analog of the reference's per-step feed_dict sync,
+        # SURVEY.md §3.1 — and dominant when the device sits behind a tunnel).
+        pending: list[tuple[int, Any]] = []
+        interval_t0 = t0
+        first_interval_len = 0  # epochs amortizing the XLA compile (see summary)
+
         for epoch in range(cfg.epochs):
             epoch_rng = jax.random.fold_in(self._data_rng, epoch)
-            te = time.perf_counter()
             if self._stream:
                 self.state, metrics = self._run_epoch_stream(self.state, epoch_rng)
             else:
                 self.state, metrics = self._run_epoch(
                     self.state, self.train_images, self.train_labels, epoch_rng
                 )
-            metrics = jax.tree.map(lambda m: float(jnp.mean(m)), jax.device_get(metrics))
-            epoch_time = time.perf_counter() - te
-            if not np.isfinite(metrics["loss"]):
-                # divergence detection (SURVEY.md §5 sanitizer analog): fail
-                # loudly, with the offending leaves localized, after letting
-                # any in-flight async checkpoint land (run_with_recovery will
-                # reopen this directory immediately)
-                from distributed_tensorflow_ibm_mnist_tpu.utils.debug import (
-                    TrainingDiverged,
-                    find_nonfinite,
-                )
+            pending.append((epoch, metrics))
+            eval_now = (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1
+            preempt_now = preemption is not None and preemption.triggered
+            ckpt_now = (
+                self._ckpt is not None
+                and cfg.checkpoint_every
+                and (epoch + 1) % cfg.checkpoint_every == 0
+            )
+            if not (eval_now or preempt_now or ckpt_now):
+                continue  # keep the device queue full; no host sync this epoch
 
-                if self._ckpt is not None:
-                    self._ckpt.wait()
-                raise TrainingDiverged(
-                    f"non-finite train loss in epoch {epoch}",
-                    step=int(jax.device_get(self.state.step)),
-                    bad_leaves=find_nonfinite(self.state.params),
-                )
-            epoch_times.append(epoch_time)
+            fetched = jax.device_get([m for _, m in pending])
+            interval = time.perf_counter() - interval_t0
+            epoch_time = interval / len(pending)  # amortized over the interval
+            if first_interval_len == 0:
+                first_interval_len = len(pending)
             images = self.steps_per_epoch * cfg.batch_size
-            record = {
-                "epoch": epoch,
-                "train_loss": metrics["loss"],
-                "train_accuracy": metrics["accuracy"],
-                "epoch_time_s": round(epoch_time, 4),
-                "images_per_sec": round(images / epoch_time, 1),
-                "images_per_sec_per_chip": round(images / epoch_time / chips, 1),
-            }
-            if (epoch + 1) % cfg.eval_every == 0 or epoch == cfg.epochs - 1:
-                ev = self.evaluate()
-                record["test_accuracy"] = ev["accuracy"]
-                record["test_loss"] = ev["loss"]
-                best_acc = max(best_acc, ev["accuracy"])
-                if (
-                    time_to_target is None
-                    and cfg.target_accuracy
-                    and ev["accuracy"] >= cfg.target_accuracy
-                ):
-                    time_to_target = time.perf_counter() - t0
-            self.history.append(record)
-            self.writer.write("epoch", step=int(jax.device_get(self.state.step)), **record)
-            if self._ckpt is not None and cfg.checkpoint_every and (epoch + 1) % cfg.checkpoint_every == 0:
+            for (ep, _), mh in zip(pending, fetched):
+                mh = {k: float(np.mean(v)) for k, v in mh.items()}
+                if not np.isfinite(mh["loss"]):
+                    # divergence detection (SURVEY.md §5 sanitizer analog):
+                    # fail loudly, with the offending leaves localized, after
+                    # letting any in-flight async checkpoint land
+                    # (run_with_recovery will reopen this directory)
+                    from distributed_tensorflow_ibm_mnist_tpu.utils.debug import (
+                        TrainingDiverged,
+                        find_nonfinite,
+                    )
+
+                    if self._ckpt is not None:
+                        self._ckpt.wait()
+                    raise TrainingDiverged(
+                        f"non-finite train loss in epoch {ep}",
+                        step=step0 + self.steps_per_epoch * (ep + 1),
+                        bad_leaves=find_nonfinite(self.state.params),
+                    )
+                epoch_times.append(epoch_time)
+                record = {
+                    "epoch": ep,
+                    "train_loss": mh["loss"],
+                    "train_accuracy": mh["accuracy"],
+                    "epoch_time_s": round(epoch_time, 4),
+                    "images_per_sec": round(images / epoch_time, 1),
+                    "images_per_sec_per_chip": round(images / epoch_time / chips, 1),
+                }
+                if ep == epoch and eval_now:
+                    ev = self.evaluate()
+                    record["test_accuracy"] = ev["accuracy"]
+                    record["test_loss"] = ev["loss"]
+                    best_acc = max(best_acc, ev["accuracy"])
+                    if (
+                        time_to_target is None
+                        and cfg.target_accuracy
+                        and ev["accuracy"] >= cfg.target_accuracy
+                    ):
+                        time_to_target = time.perf_counter() - t0
+                self.history.append(record)
+                self.writer.write("epoch", step=step0 + self.steps_per_epoch * (ep + 1), **record)
+            pending.clear()
+            if ckpt_now:
                 self.save_checkpoint(wait=False)
             if time_to_target is not None and cfg.target_accuracy:
                 break
-            if preemption is not None and preemption.triggered:
+            if preempt_now:
                 preempted = True
                 self.save_checkpoint(wait=True)
                 self.writer.write("preempted", step=int(jax.device_get(self.state.step)))
                 break
+            interval_t0 = time.perf_counter()
 
         total_time = time.perf_counter() - t0
-        # First epoch includes XLA compile; steady-state rate excludes it.
-        steady = epoch_times[1:] or epoch_times
+        # The first fetch interval includes XLA compile (amortized over its
+        # epochs); the steady-state rate excludes that whole interval, and the
+        # compile overhead is the first interval's excess over steady pace.
+        steady = epoch_times[first_interval_len:] or epoch_times
+        steady_mean = sum(steady) / len(steady) if steady else 0.0
+        compile_overhead = (
+            max(0.0, (epoch_times[0] - steady_mean) * first_interval_len)
+            if epoch_times
+            else 0.0
+        )
         images = self.steps_per_epoch * cfg.batch_size
         summary = {
             "name": cfg.name,
             "epochs_run": len(epoch_times),
             "total_time_s": round(total_time, 3),
-            "compile_overhead_s": round(epoch_times[0] - min(epoch_times), 3),
+            "compile_overhead_s": round(compile_overhead, 3),
             "best_test_accuracy": best_acc,
             "time_to_target_s": round(time_to_target, 3) if time_to_target else None,
             "target_accuracy": cfg.target_accuracy,

@@ -100,3 +100,56 @@ def test_trainer_config_resume_flag(tmp_path):
     t2 = Trainer(cfg.replace(resume=True))
     t2.fit()
     assert int(jax.device_get(t2.state.step)) == 2 * first_step
+
+
+def test_checkpoint_cadence_independent_of_eval_every(tmp_path):
+    """checkpoint_every must be honored even between eval boundaries.
+
+    Metric readbacks are deferred to eval boundaries (Trainer.fit keeps the
+    device queue full between them), but a configured checkpoint cadence is
+    its own sync point — eval_every=100 with checkpoint_every=1 still saves
+    after every epoch.
+    """
+    cfg = RunConfig(
+        name="cad", model="mlp", model_kwargs={"hidden": (32,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=32, epochs=3, dp=1, quiet=True,
+        checkpoint_dir=str(tmp_path / "cad"), checkpoint_every=1, eval_every=100,
+    )
+    t = Trainer(cfg)
+
+    seen = []
+    orig = Trainer.save_checkpoint
+
+    def spy(self, wait=True):
+        seen.append(int(jax.device_get(self.state.step)))
+        return orig(self, wait=wait)
+
+    Trainer.save_checkpoint = spy
+    try:
+        t.fit()
+    finally:
+        Trainer.save_checkpoint = orig
+    spe = t.steps_per_epoch
+    # one save per epoch cadence + the final save at exit
+    assert seen[:3] == [spe, 2 * spe, 3 * spe], seen
+
+
+def test_resume_metric_records_continue_step_axis(tmp_path):
+    """After resume, epoch records must not rewind the step axis to 0."""
+    cfg = RunConfig(
+        name="stepaxis", model="mlp", model_kwargs={"hidden": (32,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=32, epochs=2, dp=1, quiet=True,
+        checkpoint_dir=str(tmp_path / "sx"),
+    )
+    t1 = Trainer(cfg)
+    t1.fit()
+    first_steps = 2 * t1.steps_per_epoch
+
+    t2 = Trainer(cfg.replace(resume=True))
+    records = []
+    t2.writer.write = lambda kind, **kw: records.append((kind, kw))
+    t2.fit()
+    epoch_steps = [kw["step"] for kind, kw in records if kind == "epoch"]
+    assert epoch_steps[0] == first_steps + t2.steps_per_epoch, epoch_steps
