@@ -72,7 +72,8 @@ def main() -> None:
         )
     last_loss = float(jax.device_get(metrics["loss"])[-1])
     throughput_wall = time.perf_counter() - t1
-    images_per_sec = trainer.steps_per_epoch * cfg.batch_size * K / throughput_wall
+    chips = trainer.dp if trainer.dp > 1 else 1
+    images_per_sec = trainer.steps_per_epoch * cfg.batch_size * K / throughput_wall / chips
     if not math.isfinite(last_loss):
         raise RuntimeError(f"non-finite loss in throughput phase: {last_loss}")
 

@@ -312,8 +312,8 @@ class Trainer:
             "best_test_accuracy": best_acc,
             "time_to_target_s": round(time_to_target, 3) if time_to_target else None,
             "target_accuracy": cfg.target_accuracy,
-            "images_per_sec": round(images / (sum(steady) / len(steady)), 1),
-            "images_per_sec_per_chip": round(images / (sum(steady) / len(steady)) / chips, 1),
+            "images_per_sec": round(images / steady_mean, 1),
+            "images_per_sec_per_chip": round(images / steady_mean / chips, 1),
             "param_count": self.state.param_count() if self.dp == 1 else None,
         }
         if preempted:

@@ -153,3 +153,37 @@ def test_resume_metric_records_continue_step_axis(tmp_path):
     t2.fit()
     epoch_steps = [kw["step"] for kind, kw in records if kind == "epoch"]
     assert epoch_steps[0] == first_steps + t2.steps_per_epoch, epoch_steps
+
+
+def test_checkpoint_roundtrip_across_process_counts(tmp_path, eight_devices):
+    """SURVEY.md §5: a checkpoint must round-trip across device layouts.
+
+    Save from an 8-way DP (replicated) trainer, restore into a single-device
+    trainer — and back the other way — with identical params and a working
+    continued-training step in the new layout.
+    """
+    base = RunConfig(
+        name="xproc", model="mlp", model_kwargs={"hidden": (64,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=512, n_test=128,
+        batch_size=64, epochs=1, lr=2e-3, quiet=True,
+        checkpoint_dir=str(tmp_path / "xp"),
+    )
+    t8 = Trainer(base.replace(dp=8))
+    t8.fit()  # saves at exit
+    step8 = int(jax.device_get(t8.state.step))
+
+    # 8-way -> 1-way
+    t1 = Trainer(base.replace(dp=1))
+    assert t1.restore_checkpoint() == step8
+    for a, b in zip(jax.tree.leaves(t8.state.params), jax.tree.leaves(t1.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+    t1.fit()
+    assert int(jax.device_get(t1.state.step)) == step8 + t1.steps_per_epoch
+
+    # 1-way -> 8-way
+    t8b = Trainer(base.replace(dp=8))
+    assert t8b.restore_checkpoint() == int(jax.device_get(t1.state.step))
+    for a, b in zip(jax.tree.leaves(t1.state.params), jax.tree.leaves(t8b.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
+    t8b.fit()
+    assert int(jax.device_get(t8b.state.step)) > step8 + t8b.steps_per_epoch
