@@ -1,0 +1,39 @@
+"""Virtual host-CPU device meshes for development and CI.
+
+The SURVEY.md §4 test strategy — distributed behavior validated on an
+N-device CPU platform instead of "run it on the cluster to find out" —
+needs N CPU devices *reliably*.  Env vars alone
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu``)
+are not reliable everywhere: site hooks that import jax at interpreter
+start can pin ``jax_platforms`` before user code runs.  This helper arms
+the platform from inside the process, which works in both worlds.
+"""
+
+from __future__ import annotations
+
+
+def ensure_virtual_cpu_devices(n: int) -> int:
+    """Force jax onto an ``n``-device (or more) CPU platform.
+
+    Safe to call before or after ``import jax``; if backends were already
+    initialized with too few devices they are cleared and rebuilt, which
+    invalidates any live jax arrays created before the call.  Returns the
+    resulting device count.
+    """
+    import jax
+    from jax._src import xla_bridge as xb
+
+    initialized = (
+        xb.backends_are_initialized()
+        if hasattr(xb, "backends_are_initialized")
+        else bool(getattr(xb, "_backends", None))
+    )
+    if initialized and jax.default_backend() == "cpu" and len(jax.devices()) >= n:
+        return len(jax.devices())
+    if initialized:
+        import jax.extend as jex
+
+        jex.backend.clear_backends()
+    jax.config.update("jax_num_cpu_devices", n)
+    jax.config.update("jax_platforms", "cpu")
+    return len(jax.devices())

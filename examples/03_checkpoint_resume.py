@@ -1,0 +1,37 @@
+"""Checkpoint, crash, resume — the MonitoredTrainingSession Saver story.
+
+The reference's one real aux subsystem (SURVEY.md §5): the chief's Saver
+hook wrote checkpoints and a restarted job resumed from the same dir.
+Here that is explicit and layout-agnostic: the checkpoint round-trips
+across device counts (save from a DP run, resume single-chip, or vice
+versa).
+
+    python examples/03_checkpoint_resume.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root import without install
+
+import tempfile
+
+from distributed_tensorflow_ibm_mnist_tpu.core import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+if __name__ == "__main__":
+    ckpt_dir = tempfile.mkdtemp(prefix="mnist_ckpt_")
+    cfg = RunConfig(
+        name="resumable", model="lenet5", dataset="mnist",
+        batch_size=512, epochs=2, lr=2e-3,
+        checkpoint_dir=ckpt_dir, checkpoint_every=1,
+    )
+
+    print("--- first run (2 epochs, checkpointing) ---")
+    Trainer(cfg).fit()
+
+    print("--- resumed run (2 more epochs from the same dir) ---")
+    t = Trainer(cfg.replace(resume=True))
+    summary = t.fit()
+    print(f"\nfinal step {int(t.state.step)} "
+          f"(resumed past the first run's {2 * t.steps_per_epoch})")

@@ -1,0 +1,29 @@
+"""Preemption-aware training with automatic restart-from-checkpoint.
+
+The reference's recovery story was K8s pod restart + the chief's
+checkpoint (SURVEY.md §5 "Failure detection").  Here it is in-process:
+run_with_recovery reopens the checkpoint dir after a divergence or crash,
+and a PreemptionHandler turns SIGTERM into checkpoint-and-exit.
+
+    python examples/05_fault_tolerance.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root import without install
+
+import tempfile
+
+from distributed_tensorflow_ibm_mnist_tpu.core import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+from distributed_tensorflow_ibm_mnist_tpu.utils.elastic import run_with_recovery
+
+if __name__ == "__main__":
+    cfg = RunConfig(
+        name="recoverable", model="lenet5", dataset="mnist",
+        batch_size=512, epochs=3, lr=2e-3,
+        checkpoint_dir=tempfile.mkdtemp(prefix="mnist_ft_"), checkpoint_every=1,
+    )
+    summary = run_with_recovery(lambda: Trainer(cfg), max_restarts=2)
+    print(f"\nfinished: best accuracy {summary['best_test_accuracy']:.4f}")
