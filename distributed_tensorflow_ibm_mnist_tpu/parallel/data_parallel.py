@@ -35,12 +35,29 @@ def shard_dataset(mesh: Mesh, images: np.ndarray, labels: np.ndarray, axis: str 
 
     Drops a remainder of at most ``axis_size - 1`` samples so every device
     holds an equal, static-shaped shard.
+
+    Works in multi-process runs too: each process materializes only its
+    addressable devices' rows (``make_array_from_callback`` hands us the
+    per-shard global index), so hosts never ship the full dataset through
+    the cross-process value check that ``device_put`` performs.  The host
+    arrays must be replica-consistent across processes — true for the
+    deterministic loaders (data/loaders.py seeds) — since each row is read
+    on whichever host owns its shard.
     """
     size = mesh.shape[axis]
     n = (images.shape[0] // size) * size
     spec_img = P(axis, *([None] * (images.ndim - 1)))
-    imgs = jax.device_put(images[:n], NamedSharding(mesh, spec_img))
-    labs = jax.device_put(labels[:n], NamedSharding(mesh, P(axis)))
+
+    def _place(host: np.ndarray, spec: P):
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx]
+            )
+        return jax.device_put(host, sharding)
+
+    imgs = _place(images[:n], spec_img)
+    labs = _place(labels[:n], P(axis))
     return imgs, labs
 
 

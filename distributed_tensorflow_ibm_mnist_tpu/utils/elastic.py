@@ -64,14 +64,17 @@ def run_with_recovery(
     make_trainer: Callable[[], Any],
     max_restarts: int = 2,
     on_restart: Callable[[int, BaseException], None] | None = None,
+    preemption: PreemptionHandler | None = None,
 ) -> dict[str, Any]:
     """Run ``make_trainer().fit()`` with restart-from-checkpoint supervision.
 
     ``make_trainer`` must return a fresh Trainer whose config has a
     ``checkpoint_dir`` (the recovery anchor) — each retry constructs a new
     trainer with ``resume=True`` semantics forced, so it restarts from the
-    last durable step rather than from scratch.  Returns the final summary
-    with a ``restarts`` count added.
+    last durable step rather than from scratch.  ``preemption`` (a
+    :class:`PreemptionHandler`) is forwarded to every ``fit`` so SIGTERM
+    still means checkpoint-and-exit under supervision.  Returns the final
+    summary with a ``restarts`` count added.
     """
     attempt = 0
     while True:
@@ -82,7 +85,7 @@ def run_with_recovery(
                 raise ValueError("run_with_recovery needs checkpoint_dir to resume")
             trainer.config = cfg.replace(resume=True)
         try:
-            summary = trainer.fit()
+            summary = trainer.fit(preemption=preemption)
             summary["restarts"] = attempt
             return summary
         except (TrainingDiverged, FloatingPointError) as e:

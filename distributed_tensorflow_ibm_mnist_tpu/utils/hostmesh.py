@@ -12,6 +12,20 @@ the platform from inside the process, which works in both worlds.
 from __future__ import annotations
 
 
+def backends_initialized() -> bool:
+    """True once jax has built its backend clients (version-compat probe).
+
+    Unlike ``jax.devices()`` this never triggers initialization itself —
+    which matters because XLA parses its flag env exactly once, at first
+    client creation.
+    """
+    from jax._src import xla_bridge as xb
+
+    if hasattr(xb, "backends_are_initialized"):
+        return xb.backends_are_initialized()
+    return bool(getattr(xb, "_backends", None))
+
+
 def ensure_virtual_cpu_devices(n: int) -> int:
     """Force jax onto an ``n``-device (or more) CPU platform.
 
@@ -21,13 +35,8 @@ def ensure_virtual_cpu_devices(n: int) -> int:
     resulting device count.
     """
     import jax
-    from jax._src import xla_bridge as xb
 
-    initialized = (
-        xb.backends_are_initialized()
-        if hasattr(xb, "backends_are_initialized")
-        else bool(getattr(xb, "_backends", None))
-    )
+    initialized = backends_initialized()
     if initialized and jax.default_backend() == "cpu" and len(jax.devices()) >= n:
         return len(jax.devices())
     if initialized:

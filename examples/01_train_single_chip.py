@@ -21,6 +21,8 @@ if __name__ == "__main__":
         epochs=15, target_accuracy=0.99,  # early-stops at 99%
     )
     summary = Trainer(cfg).fit()
-    print(f"\nreached {summary['best_test_accuracy']:.4f} test accuracy "
-          f"in {summary['time_to_target_s']}s "
+    ttt = summary["time_to_target_s"]
+    reached = f"reached 99% in {ttt}s" if ttt else (
+        f"did not reach 99% in {summary['epochs_run']} epochs")
+    print(f"\nbest test accuracy {summary['best_test_accuracy']:.4f}; {reached} "
           f"({summary['images_per_sec_per_chip']:.0f} images/sec/chip)")

@@ -17,7 +17,10 @@ import tempfile
 
 from distributed_tensorflow_ibm_mnist_tpu.core import Trainer
 from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
-from distributed_tensorflow_ibm_mnist_tpu.utils.elastic import run_with_recovery
+from distributed_tensorflow_ibm_mnist_tpu.utils.elastic import (
+    PreemptionHandler,
+    run_with_recovery,
+)
 
 if __name__ == "__main__":
     cfg = RunConfig(
@@ -25,5 +28,9 @@ if __name__ == "__main__":
         batch_size=512, epochs=3, lr=2e-3,
         checkpoint_dir=tempfile.mkdtemp(prefix="mnist_ft_"), checkpoint_every=1,
     )
-    summary = run_with_recovery(lambda: Trainer(cfg), max_restarts=2)
-    print(f"\nfinished: best accuracy {summary['best_test_accuracy']:.4f}")
+    with PreemptionHandler() as h:  # SIGTERM/SIGINT -> checkpoint-and-exit
+        summary = run_with_recovery(lambda: Trainer(cfg), max_restarts=2, preemption=h)
+    if summary.get("preempted"):
+        print(f"\npreempted at a safe point; resume with the same checkpoint_dir")
+    else:
+        print(f"\nfinished: best accuracy {summary['best_test_accuracy']:.4f}")
