@@ -10,6 +10,7 @@ wall-clock-to-target-accuracy).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any
 
@@ -32,12 +33,56 @@ from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
 
 
+def _enable_compile_cache(cache_dir: str | None) -> None:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    "default" resolves to $DTM_COMPILE_CACHE, else <repo-root>/.cache/xla,
+    else ~/.cache/distributed_tensorflow_ibm_mnist_tpu/xla when the source tree is not
+    writable (system-wide installs).  None disables.  Idempotent and safe to
+    call after jax is initialized (the cache is consulted at compile time,
+    not at backend creation).
+    """
+    if not cache_dir:
+        return
+    if cache_dir == "default":
+        candidates = [os.environ.get("DTM_COMPILE_CACHE")] if os.environ.get(
+            "DTM_COMPILE_CACHE"
+        ) else [
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+                ".cache", "xla",
+            ),
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "distributed_tensorflow_ibm_mnist_tpu", "xla"
+            ),
+        ]
+        cache_dir = None
+        for cand in candidates:
+            try:
+                os.makedirs(cand, exist_ok=True)
+                cache_dir = cand
+                break
+            except OSError:
+                continue
+        if cache_dir is None:
+            return
+    try:
+        if jax.config.jax_compilation_cache_dir != cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # cache even fast compiles: the hot configs here compile in
+            # seconds but are re-run constantly (benchmarks, CI, presets)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:
+        pass  # cache is an optimization; never fail a run over it
+
+
 class Trainer:
     """Owns the compiled functions + train state for one run."""
 
     def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None):
         self.config = config
         self.writer = writer or MetricWriter(path=config.metrics_path, stdout=not config.quiet)
+        _enable_compile_cache(config.compile_cache_dir)
 
         data = load_dataset(
             config.dataset, n_train=config.n_train, n_test=config.n_test,

@@ -55,6 +55,12 @@ class RunConfig:
     resume: bool = False  # restore latest checkpoint from checkpoint_dir before training
     metrics_path: str | None = None  # JSONL file (always also stdout unless quiet)
     quiet: bool = False  # suppress stdout metric lines (tests/benchmarks)
+    # Persistent XLA compilation cache: repeat runs skip the one-time compile
+    # (the analog of the reference having no compile stage at all). None
+    # disables; "default" resolves to $DTM_COMPILE_CACHE if set, else
+    # <repo-root>/.cache/xla (falling back to ~/.cache/... when that tree is
+    # not writable, e.g. a system-wide pip install).
+    compile_cache_dir: str | None = "default"
 
     def replace(self, **kw) -> "RunConfig":
         return dataclasses.replace(self, **kw)
