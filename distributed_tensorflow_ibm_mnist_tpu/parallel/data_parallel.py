@@ -23,7 +23,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
 from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_epoch_runner, make_train_step
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
 

@@ -22,7 +22,6 @@ auxiliary loss, returned separately).
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import flax.linen as nn

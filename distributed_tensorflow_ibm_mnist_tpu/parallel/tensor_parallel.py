@@ -21,11 +21,10 @@ import re
 from typing import Any, Callable
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
-from distributed_tensorflow_ibm_mnist_tpu.core.steps import Batch, make_train_step
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
 
 SpecRule = Callable[[tuple[str, ...], Any], P]
 

@@ -12,10 +12,7 @@ because the state is just a pytree that gets re-placed by the caller
 from __future__ import annotations
 
 import os
-from typing import Any
-
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState

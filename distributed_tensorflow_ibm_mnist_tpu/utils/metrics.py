@@ -10,7 +10,6 @@ machine-readable JSON lines, with optional TensorBoard event files.
 from __future__ import annotations
 
 import json
-import sys
 import time
 from typing import Any, IO
 

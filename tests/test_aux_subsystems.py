@@ -3,7 +3,6 @@ injection, preemption, and restart-from-checkpoint recovery."""
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer

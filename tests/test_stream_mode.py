@@ -1,7 +1,6 @@
 """Streaming input mode: host-resident data + C++ prefetcher feeding the
 per-step compiled train step — single-device and DP."""
 
-import numpy as np
 import pytest
 
 from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
