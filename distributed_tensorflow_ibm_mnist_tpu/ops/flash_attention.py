@@ -1,13 +1,17 @@
 """Pallas TPU kernel: flash attention (fwd + custom VJP bwd).
 
 The transformer family's hot op (models/transformer.py), as a blockwise
-VMEM-resident kernel: per (batch*head, q-tile) grid cell the kernel streams
-K/V in tiles with an online-softmax accumulator, so the (S x S) score
-matrix never exists in HBM — O(S) memory against vanilla attention's O(S^2)
-— and the matmuls hit the MXU in f32 accumulation regardless of input
-dtype.  The backward pass is the standard flash recompute scheme, also in
-Pallas: probabilities are rebuilt blockwise from the saved row logsumexp,
-one kernel accumulating dK/dV over q-tiles and one accumulating dQ over
+VMEM-resident kernel.  The grid is 3-D — ``(batch*head, out-tile,
+reduce-tile)`` with the reduction axis innermost and marked "arbitrary" —
+so only single (tile x head_dim) blocks of Q/K/V/dO are ever resident in
+VMEM while online-softmax (fwd) / recompute (bwd) accumulators live in
+VMEM scratch across the innermost grid steps.  The (S x S) score matrix
+never exists in HBM and VMEM stays O(tile), so sequence length scales to
+HBM capacity (vs the O(S) VMEM of a whole-row design that tops out around
+S~4k on v5e).  Matmuls hit the MXU in f32 accumulation regardless of
+input dtype.  The backward pass is the standard flash recompute scheme:
+probabilities are rebuilt blockwise from the saved row logsumexp, one
+kernel accumulating dK/dV over q-tiles and one accumulating dQ over
 k-tiles.
 
 Layout is (B, S, H, D) like the rest of the framework; head_dim is padded
@@ -28,6 +32,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
@@ -45,102 +50,125 @@ def _pick_block(n: int, target: int = 128) -> int:
     return n
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k, s_real, causal, block_q):
-    # q_ref: (1, Tq, D); k_ref/v_ref: (1, S, D); outputs (1, Tq, D), (1, Tq, 1)
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (Tq, D)
-    tq, d = q.shape
-    s = k_ref.shape[1]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
+                *, sm_scale, block_q, block_k, n_k, s_real, causal):
+    # grid (bh, q-tile, k-tile), k innermost; scratch carries the online
+    # softmax state (m, l, acc) across k-tiles of one q-tile.
+    qi, ki = pl.program_id(1), pl.program_id(2)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Tq, Bk)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 1)
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
+        k = k_ref[0].astype(jnp.float32)             # (Bk, D)
+        v = v_ref[0].astype(jnp.float32)
+        tq, bk = q.shape[0], k.shape[0]
+        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
         mask = k_pos < s_real
         if causal:
             mask = mask & (k_pos <= q_pos)
         scores = jnp.where(mask, scores, _NEG)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot(p, v)
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((tq, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((tq, 1), jnp.float32)
-    acc0 = jnp.zeros((tq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, s // block_k, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padding) rows -> 0
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+        m_prev, l_prev, acc_prev = m_sc[...], l_sc[...], acc_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_sc[...] = m_new
+        l_sc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = acc_prev * corr + jax.lax.dot(p, v)
+
+    # NOTE: gating dead above-diagonal causal tiles with pl.when was measured
+    # on v5e and does NOT help: block DMA is issued by the BlockSpec pipeline
+    # regardless of the body predicate, and the scalar guard costs pipeline
+    # overlap (S=8192 causal: 860ms gated vs ~720ms ungated). Keep unconditional.
+    _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_sc[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padding) rows
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_sc[...] + jnp.log(l_safe)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                *, sm_scale, block_q, s_real, causal, block_k):
-    # grid cell: one k-tile; loop q-tiles accumulating dK/dV.
-    ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # (Bk, D)
-    v = v_ref[0].astype(jnp.float32)
-    bk, d = k.shape
-    sq = q_ref.shape[1]
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+                dk_sc, dv_sc, *, sm_scale, block_q, block_k, n_q, s_real, causal):
+    # grid (bh, k-tile, q-tile), q innermost; scratch accumulates dK/dV.
+    ki, qi = pl.program_id(1), pl.program_id(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :]
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q), :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)   # (Bk, D)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        bq, bk = q.shape[0], k.shape[0]
         scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Bq, Bk)
-        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = (k_pos < s_real) & (q_pos < s_real)
         if causal:
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed probs
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dv_sc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (Bq, Bk)
-        # with the scale folded into q, dK = dS^T @ q_folded directly
         ds = p * (dp - delta)
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
-        return dk_new, dv_new
+        # with the scale folded into q, dK = dS^T @ q_folded directly
+        dk_sc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, sq // block_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    _compute()  # see causal-gating NOTE in _fwd_kernel
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, sm_scale, block_k, s_real, causal, block_q):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (Tq, D)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    tq, d = q.shape
-    s = k_ref.shape[1]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
+               *, sm_scale, block_q, block_k, n_k, s_real, causal):
+    # grid (bh, q-tile, k-tile), k innermost; scratch accumulates dQ.
+    qi, ki = pl.program_id(1), pl.program_id(2)
 
-    def body(j, dq):
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        tq, bk = q.shape[0], k.shape[0]
         scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
-        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, block_k), 1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
         mask = k_pos < s_real
         if causal:
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
         ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot(ds, k)
+        dq_sc[...] += jax.lax.dot(ds, k)
 
-    dq = jax.lax.fori_loop(0, s // block_k, body, jnp.zeros((tq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    _compute()  # see causal-gating NOTE in _fwd_kernel
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _pad(x, s_pad, d_pad):
@@ -159,6 +187,17 @@ def _prepare(q, k, v):
     return q, k, v, (b, s, h, d)
 
 
+def _grid_params(interpret):
+    if interpret:
+        return {"interpret": True}
+    return {
+        "interpret": False,
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    }
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, interpret):
     out, _ = _flash_fwd(q, k, v, causal, interpret)
@@ -172,28 +211,34 @@ def _flash_fwd(q, k, v, causal, interpret):
     bh, sp, dp_ = qp.shape
     block_q = _pick_block(sp)
     block_k = _pick_block(sp)
+    n_k = sp // block_k
     sm_scale = d**-0.5
     kernel = partial(
-        _fwd_kernel, sm_scale=sm_scale, block_k=block_k, s_real=s,
-        causal=causal, block_q=block_q,
+        _fwd_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        n_k=n_k, s_real=s, causal=causal,
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, sp // block_q),
+        grid=(bh, sp // block_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
-            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
             jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32),
         ],
-        interpret=interpret,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),    # m
+            pltpu.VMEM((block_q, 1), jnp.float32),    # l
+            pltpu.VMEM((block_q, dp_), jnp.float32),  # acc
+        ],
+        **_grid_params(interpret),
     )(qp, kp, vp)
     out_bshd = out[:, :s, :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out_bshd, (q, k, v, out_bshd, lse)
@@ -208,49 +253,56 @@ def _flash_bwd(causal, interpret, res, g):
     bh, sp, dp_ = qp.shape
     block_q = _pick_block(sp)
     block_k = _pick_block(sp)
+    n_q = sp // block_q
+    n_k = sp // block_k
     sm_scale = d**-0.5
     # delta_i = rowsum(dO_i * O_i) — the flash-bwd correction term
     delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1, keepdims=True)
 
     dkv = pl.pallas_call(
-        partial(_dkv_kernel, sm_scale=sm_scale, block_q=block_q, s_real=s,
-                causal=causal, block_k=block_k),
-        grid=(bh, sp // block_k),
+        partial(_dkv_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                n_q=n_q, s_real=s, causal=causal),
+        grid=(bh, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, sp, dp_), lambda b_, j: (b_, 0, 0)),      # q
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),  # k tile
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),  # v tile
-            pl.BlockSpec((1, sp, dp_), lambda b_, j: (b_, 0, 0)),      # do
-            pl.BlockSpec((1, sp, 1), lambda b_, j: (b_, 0, 0)),        # lse
-            pl.BlockSpec((1, sp, 1), lambda b_, j: (b_, 0, 0)),        # delta
+            pl.BlockSpec((1, block_q, dp_), lambda b_, j, i: (b_, i, 0)),   # q tile
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),   # k tile
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),   # v tile
+            pl.BlockSpec((1, block_q, dp_), lambda b_, j, i: (b_, i, 0)),   # do tile
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),     # lse
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),     # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
             jax.ShapeDtypeStruct((bh, sp, dp_), v.dtype),
         ],
-        interpret=interpret,
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp_), jnp.float32),  # dk
+            pltpu.VMEM((block_k, dp_), jnp.float32),  # dv
+        ],
+        **_grid_params(interpret),
     )(qp, kp, vp, gp, lse, delta)
     dk_p, dv_p = dkv
 
     dq_p = pl.pallas_call(
-        partial(_dq_kernel, sm_scale=sm_scale, block_k=block_k, s_real=s,
-                causal=causal, block_q=block_q),
-        grid=(bh, sp // block_q),
+        partial(_dq_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                n_k=n_k, s_real=s, causal=causal),
+        grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
-            pl.BlockSpec((1, sp, dp_), lambda b_, i: (b_, 0, 0)),
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp_), lambda b_, i: (b_, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
-        interpret=interpret,
+        scratch_shapes=[pltpu.VMEM((block_q, dp_), jnp.float32)],  # dq
+        **_grid_params(interpret),
     )(qp, kp, vp, gp, lse, delta)
 
     def from_bh(x):
