@@ -219,6 +219,33 @@ def make_epoch_runner(
     return run_epoch
 
 
+def make_chunk_runner(
+    model,
+    tx: optax.GradientTransformation,
+    axis_name: str | None = None,
+    label_smoothing: float = 0.0,
+    fused_xent: bool = False,
+    remat: bool = False,
+    grad_accum: int = 1,
+):
+    """Scan the train step over a leading chunk axis of stacked batches.
+
+    ``run_chunk(state, batches)`` with ``batches`` leaves shaped
+    ``(k, batch, ...)`` runs ``k`` consecutive steps in one compiled call —
+    the stream-mode companion to :func:`make_epoch_runner`, letting the
+    host ship ``k`` batches per transfer instead of one.
+    """
+    train_step = make_train_step(
+        model, tx, axis_name=axis_name, label_smoothing=label_smoothing,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+    )
+
+    def run_chunk(state: TrainState, batches: Batch):
+        return jax.lax.scan(train_step, state, batches)
+
+    return run_chunk
+
+
 def make_eval_fn(model, batch_size: int = 2000):
     """Full-dataset eval as one compiled scan (pad + mask for any size)."""
     loss_fn = make_loss_fn(model)

@@ -120,6 +120,10 @@ class Trainer:
         if config.input_mode not in ("device", "stream"):
             raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
         self._stream = config.input_mode == "stream"
+        step_kw = dict(
+            label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
+            remat=config.remat, grad_accum=config.grad_accum,
+        )
         if self._stream:
             # host-resident dataset (HBM holds only the in-flight batches);
             # batches are assembled by the C++ prefetcher (data/native.py,
@@ -128,25 +132,24 @@ class Trainer:
             self.train_labels = np.ascontiguousarray(data["train_labels"], np.int32)
             if self.dp > 1:
                 from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+                    make_dp_chunk_runner,
                     make_dp_train_step,
                 )
 
                 state = replicate(self.mesh, state)
-                self._train_step = make_dp_train_step(
-                    self.model, self.tx, self.mesh,
-                    label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
-                    remat=config.remat, grad_accum=config.grad_accum,
-                )
+                self._train_step = make_dp_train_step(self.model, self.tx, self.mesh, **step_kw)
+                self._train_chunk = make_dp_chunk_runner(self.model, self.tx, self.mesh, **step_kw)
             else:
-                from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
+                from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
+                    make_chunk_runner,
+                    make_train_step,
+                )
 
                 self._train_step = jax.jit(
-                    make_train_step(
-                        self.model, self.tx,
-                        label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
-                    remat=config.remat, grad_accum=config.grad_accum,
-                    ),
-                    donate_argnums=(0,),
+                    make_train_step(self.model, self.tx, **step_kw), donate_argnums=(0,)
+                )
+                self._train_chunk = jax.jit(
+                    make_chunk_runner(self.model, self.tx, **step_kw), donate_argnums=(0,)
                 )
         elif self.dp > 1:
             self.train_images, self.train_labels = shard_dataset(
@@ -154,19 +157,13 @@ class Trainer:
             )
             state = replicate(self.mesh, state)
             self._run_epoch = make_dp_epoch_runner(
-                self.model, self.tx, config.batch_size, self.mesh,
-                label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
-                    remat=config.remat, grad_accum=config.grad_accum,
+                self.model, self.tx, config.batch_size, self.mesh, **step_kw
             )
         else:
             self.train_images = jax.device_put(data["train_images"])
             self.train_labels = jax.device_put(data["train_labels"])
             self._run_epoch = jax.jit(
-                make_epoch_runner(
-                    self.model, self.tx, config.batch_size,
-                    label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
-                    remat=config.remat, grad_accum=config.grad_accum,
-                ),
+                make_epoch_runner(self.model, self.tx, config.batch_size, **step_kw),
                 donate_argnums=(0,),
             )
 
@@ -200,9 +197,12 @@ class Trainer:
         return int(jax.device_get(self.state.step))
 
     def _run_epoch_stream(self, state, epoch_rng):
-        """One epoch in stream mode: C++-prefetched host batches -> per-step
-        compiled train step.  Metrics stay device-side until epoch end so the
-        dispatch pipeline never blocks on a host readback."""
+        """One epoch in stream mode: C++-prefetched host batches -> compiled
+        steps.  Batches are shipped in chunks of ``stream_chunk`` — ONE
+        host->device transfer per chunk, then a compiled scan over its steps —
+        so per-step transfer latency (brutal on tunnelled/remote devices) is
+        amortized ``stream_chunk``-fold.  Metrics stay device-side until epoch
+        end so the dispatch pipeline never blocks on a host readback."""
         from distributed_tensorflow_ibm_mnist_tpu.data.native import Prefetcher
 
         cfg = self.config
@@ -211,17 +211,46 @@ class Trainer:
         perm = np.random.default_rng(seed).permutation(n)[
             : self.steps_per_epoch * cfg.batch_size
         ].astype(np.int32)
+        chunk = max(1, cfg.stream_chunk)
         ms = []
+        pending_imgs: list[np.ndarray] = []
+        pending_labs: list[np.ndarray] = []
+
+        def flush(state):
+            k = len(pending_imgs)
+            if k == chunk and chunk > 1:
+                batches = {
+                    "image": jnp.asarray(np.stack(pending_imgs)),
+                    "label": jnp.asarray(np.stack(pending_labs)),
+                }
+                state, m = self._train_chunk(state, batches)  # scan over k steps
+                ms.append(m)
+            else:
+                # epoch-end remainder (k < chunk): drain through the per-step
+                # program instead of compiling a second k-step scan shape
+                for img, lab in zip(pending_imgs, pending_labs):
+                    batch = {"image": jnp.asarray(img), "label": jnp.asarray(lab)}
+                    state, m = self._train_step(state, batch)
+                    ms.append(m)
+            pending_imgs.clear()
+            pending_labs.clear()
+            return state
+
         with Prefetcher(
             self.train_images, self.train_labels, cfg.batch_size, perm,
             depth=cfg.prefetch_depth,
         ) as pf:
             for img, lab in pf:
-                batch = {"image": jnp.asarray(img), "label": jnp.asarray(lab)}
-                state, m = self._train_step(state, batch)
-                ms.append(m)
-        metrics = {k: jnp.stack([m[k] for m in ms]) for k in ms[0]}
-        return state, metrics
+                pending_imgs.append(img)
+                pending_labs.append(lab)
+                if len(pending_imgs) == chunk:
+                    state = flush(state)
+        state = flush(state)
+        # per-chunk metrics are (k,)-stacked; per-step ones are scalars
+        flat = {
+            k: jnp.concatenate([jnp.atleast_1d(m[k]) for m in ms]) for k in ms[0]
+        }
+        return state, flat
 
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))

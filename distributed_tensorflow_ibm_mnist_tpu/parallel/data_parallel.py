@@ -23,7 +23,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_epoch_runner, make_train_step
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
+    make_chunk_runner,
+    make_epoch_runner,
+    make_train_step,
+)
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
 
 AXIS = "data"
@@ -86,6 +90,27 @@ def make_dp_train_step(
         train_step,
         mesh,
         in_specs=(P(), {"image": img_spec, "label": P(axis)}),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(wrapped, donate_argnums=(0,))
+
+
+def make_dp_chunk_runner(
+    model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0,
+    fused_xent: bool = False, remat: bool = False, grad_accum: int = 1,
+):
+    """DP companion of steps.make_chunk_runner: scan k stacked global batches
+    (leaves ``(k, global_batch, ...)``, batch dim sharded over ``axis``) in one
+    compiled shard_map call — stream mode's one-transfer-per-k-steps path."""
+    run_chunk = make_chunk_runner(
+        model, tx, axis_name=axis, label_smoothing=label_smoothing,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+    )
+    img_spec = P(None, axis, *([None] * 3))
+    wrapped = shard_map_compat(
+        run_chunk,
+        mesh,
+        in_specs=(P(), {"image": img_spec, "label": P(None, axis)}),
         out_specs=(P(), P()),
     )
     return jax.jit(wrapped, donate_argnums=(0,))

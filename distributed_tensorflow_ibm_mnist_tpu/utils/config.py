@@ -43,6 +43,8 @@ class RunConfig:
     input_mode: str = "device"  # device: dataset HBM-resident, scan epochs;
     #                             stream: host-resident, C++-prefetched per-step batches
     prefetch_depth: int = 3  # stream mode: batches assembled ahead of the consumer
+    stream_chunk: int = 8  # stream mode: batches per host->device transfer (1 = per-step);
+    #                        each chunk is one compiled scan, amortizing transfer latency
     # parallelism
     dp: int = 1  # data-parallel degree; 0 => all visible devices
     # run control
