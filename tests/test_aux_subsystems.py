@@ -134,3 +134,42 @@ def test_run_with_recovery_gives_up(tmp_path):
 
     with pytest.raises(dbg.TrainingDiverged):
         run_with_recovery(make_trainer, max_restarts=1)
+
+
+def test_metric_writer_jsonl_and_tensorboard(tmp_path):
+    """MetricWriter: JSONL file round-trip + TensorBoard event emission."""
+    import json
+
+    from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+    path = tmp_path / "m.jsonl"
+    tb = tmp_path / "tb"
+    w = MetricWriter(path=str(path), stdout=False, tensorboard_dir=str(tb))
+    w.write("epoch", step=10, loss=0.5, accuracy=0.9)
+    w.write("summary", images_per_sec_per_chip=1e5)
+    w.close()
+
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["kind"] for r in records] == ["epoch", "summary"]
+    assert records[0]["step"] == 10 and records[0]["loss"] == 0.5
+    assert all("t" in r for r in records)
+    event_files = list(tb.rglob("*tfevents*"))
+    assert event_files, "no tensorboard event files written"
+
+
+def test_hostmesh_ensure_virtual_cpu_devices():
+    """ensure_virtual_cpu_devices is a no-op when already satisfied and
+    reports the live device count."""
+    import jax
+
+    from distributed_tensorflow_ibm_mnist_tpu.utils.hostmesh import (
+        backends_initialized,
+        ensure_virtual_cpu_devices,
+    )
+
+    # conftest armed an 8-device CPU platform; asking for <= that must not
+    # rebuild backends (which would invalidate every live array in the suite).
+    marker = jax.numpy.ones((2,))
+    assert backends_initialized()
+    assert ensure_virtual_cpu_devices(8) >= 8
+    assert float(marker.sum()) == 2.0  # still alive => no rebuild happened
