@@ -45,6 +45,12 @@ def _enable_compile_cache(cache_dir: str | None) -> None:
     if not cache_dir:
         return
     if cache_dir == "default":
+        # Default-on only for accelerator backends: XLA:CPU persists AOT
+        # artifacts keyed loosely enough that cross-process machine-feature
+        # drift triggers "could lead to SIGILL" reloads. An explicit dir
+        # still opts CPU in.
+        if jax.default_backend() == "cpu":
+            return
         candidates = [os.environ.get("DTM_COMPILE_CACHE")] if os.environ.get(
             "DTM_COMPILE_CACHE"
         ) else [
@@ -90,9 +96,10 @@ class Trainer:
         )
         self.num_classes = data["num_classes"]
 
-        self.dp = config.dp if config.dp else len(jax.devices())
-        if self.dp > 1 and mesh is None:
-            mesh = make_mesh(dp=self.dp)
+        self.tp = max(1, config.tp)
+        self.dp = config.dp if config.dp else max(1, len(jax.devices()) // self.tp)
+        if mesh is None and (self.dp > 1 or self.tp > 1):
+            mesh = make_mesh(dp=self.dp, tp=self.tp)
         self.mesh = mesh
 
         n_train = data["train_images"].shape[0]
@@ -104,8 +111,10 @@ class Trainer:
         total_steps = self.steps_per_epoch * config.epochs
 
         model_kwargs = dict(config.model_kwargs)
-        if self.dp > 1 and model_accepts(config.model, "axis_name"):
-            # cross-replica BatchNorm: global-batch moments via pmean over ICI
+        if self.dp > 1 and self.tp == 1 and model_accepts(config.model, "axis_name"):
+            # cross-replica BatchNorm: global-batch moments via pmean over ICI.
+            # (The TP path runs under GSPMD, where there is no named axis and
+            # BN moments are already semantically global.)
             model_kwargs.setdefault("axis_name", "data")
         self.model = get_model(
             config.model, num_classes=self.num_classes, **model_kwargs
@@ -120,6 +129,8 @@ class Trainer:
         if config.input_mode not in ("device", "stream"):
             raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
         self._stream = config.input_mode == "stream"
+        if self._stream and self.tp > 1:
+            raise ValueError("input_mode='stream' does not compose with tp>1; use device mode")
         step_kw = dict(
             label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
             remat=config.remat, grad_accum=config.grad_accum,
@@ -151,6 +162,26 @@ class Trainer:
                 self._train_chunk = jax.jit(
                     make_chunk_runner(self.model, self.tx, **step_kw), donate_argnums=(0,)
                 )
+        elif self.tp > 1:
+            # DP x TP under GSPMD: Megatron specs on dense stacks, dataset
+            # sharded over 'data', the whole epoch one jitted scan — same
+            # shape as the other paths, only shardings differ.
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+                make_param_specs,
+                make_tp_epoch_runner,
+                megatron_dense_rule,
+                shard_train_state,
+            )
+
+            self._tp_specs = make_param_specs(state.params, megatron_dense_rule())
+            self._run_epoch = make_tp_epoch_runner(
+                self.model, self.tx, self.mesh, self._tp_specs, state,
+                config.batch_size, **step_kw,
+            )
+            self.train_images, self.train_labels = shard_dataset(
+                self.mesh, data["train_images"], data["train_labels"]
+            )
+            state = shard_train_state(self.mesh, state, self._tp_specs)
         elif self.dp > 1:
             self.train_images, self.train_labels = shard_dataset(
                 self.mesh, data["train_images"], data["train_labels"]
@@ -189,7 +220,13 @@ class Trainer:
         if self._ckpt is None:
             raise ValueError("no checkpoint_dir configured")
         restored = self._ckpt.restore(jax.device_get(self.state), step=step)
-        if self.dp > 1:
+        if self.tp > 1:
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+                shard_train_state,
+            )
+
+            restored = shard_train_state(self.mesh, restored, self._tp_specs)
+        elif self.dp > 1:
             restored = replicate(self.mesh, restored)
         else:
             restored = jax.device_put(restored)
@@ -269,7 +306,7 @@ class Trainer:
         if cfg.resume and self._ckpt is not None and self._ckpt.latest_step() is not None:
             step = self.restore_checkpoint()
             self.writer.write("resume", step=step)
-        chips = self.dp if self.dp > 1 else 1
+        chips = max(1, self.dp) * max(1, self.tp)
         # Step base for metric records: nonzero after a checkpoint resume
         # (the epoch counter restarts at 0 but state.step does not).
         step0 = int(jax.device_get(self.state.step))

@@ -24,7 +24,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
-from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_train_step
+from distributed_tensorflow_ibm_mnist_tpu.core.steps import make_epoch_runner, make_train_step
 
 SpecRule = Callable[[tuple[str, ...], Any], P]
 
@@ -127,6 +127,8 @@ def make_tp_train_step(
     data_axis: str = "data",
     label_smoothing: float = 0.0,
     fused_xent: bool = False,
+    remat: bool = False,
+    grad_accum: int = 1,
 ):
     """Jit the plain train step under combined DP x TP GSPMD shardings.
 
@@ -137,18 +139,63 @@ def make_tp_train_step(
     activation gathers over ``model`` from the sharding constraints alone.
     """
     train_step = make_train_step(
-        model, tx, axis_name=None, label_smoothing=label_smoothing, fused_xent=fused_xent
+        model, tx, axis_name=None, label_smoothing=label_smoothing,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
-    st_shard = state_shardings(mesh, state, param_specs)
-    img_ndim = 4  # NHWC
-    batch_shard = {
-        "image": NamedSharding(mesh, P(data_axis, *([None] * (img_ndim - 1)))),
-        "label": NamedSharding(mesh, P(data_axis)),
-    }
-    metric_shard = NamedSharding(mesh, P())
+    st_shard, img_shard, lab_shard, metric_shard = _tp_shardings(
+        mesh, state, param_specs, data_axis
+    )
     return jax.jit(
         train_step,
-        in_shardings=(st_shard, batch_shard),
+        in_shardings=(st_shard, {"image": img_shard, "label": lab_shard}),
+        out_shardings=(st_shard, {"loss": metric_shard, "accuracy": metric_shard}),
+        donate_argnums=(0,),
+    )
+
+
+def _tp_shardings(mesh: Mesh, state: TrainState, param_specs, data_axis: str):
+    """(state, image, label, metric) NamedShardings for the DP x TP layout."""
+    st_shard = state_shardings(mesh, state, param_specs)
+    img_ndim = 4  # NHWC
+    img_shard = NamedSharding(mesh, P(data_axis, *([None] * (img_ndim - 1))))
+    lab_shard = NamedSharding(mesh, P(data_axis))
+    metric_shard = NamedSharding(mesh, P())
+    return st_shard, img_shard, lab_shard, metric_shard
+
+
+def make_tp_epoch_runner(
+    model,
+    tx,
+    mesh: Mesh,
+    param_specs,
+    state: TrainState,
+    batch_size: int,
+    data_axis: str = "data",
+    label_smoothing: float = 0.0,
+    fused_xent: bool = False,
+    remat: bool = False,
+    grad_accum: int = 1,
+):
+    """Whole-epoch scan under DP x TP GSPMD shardings — the Trainer's TP path.
+
+    ``run_epoch(state, images, labels, epoch_rng) -> (state, metrics)`` with
+    the dataset device-resident (batch dim sharded over ``data_axis``) and a
+    fresh device-side permutation per epoch.  The body IS
+    :func:`~...core.steps.make_epoch_runner`'s (``axis_name=None``); instead
+    of a ``shard_map`` wrapper, the partitioner propagates the state/batch
+    shardings through the scan (the per-step gather of a data-sharded
+    dataset becomes ICI traffic, which is what ICI is for).
+    """
+    run_epoch = make_epoch_runner(
+        model, tx, batch_size, axis_name=None, label_smoothing=label_smoothing,
+        fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+    )
+    st_shard, img_shard, lab_shard, metric_shard = _tp_shardings(
+        mesh, state, param_specs, data_axis
+    )
+    return jax.jit(
+        run_epoch,
+        in_shardings=(st_shard, img_shard, lab_shard, None),
         out_shardings=(st_shard, {"loss": metric_shard, "accuracy": metric_shard}),
         donate_argnums=(0,),
     )

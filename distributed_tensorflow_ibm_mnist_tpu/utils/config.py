@@ -46,7 +46,9 @@ class RunConfig:
     stream_chunk: int = 8  # stream mode: batches per host->device transfer (1 = per-step);
     #                        each chunk is one compiled scan, amortizing transfer latency
     # parallelism
-    dp: int = 1  # data-parallel degree; 0 => all visible devices
+    dp: int = 1  # data-parallel degree; 0 => all visible devices (divided by tp first)
+    tp: int = 1  # tensor-parallel degree over the 'model' mesh axis (GSPMD
+    #              Megatron specs on dense_{i} stacks; composes with dp)
     # run control
     seed: int = 0
     target_accuracy: float | None = None  # stop early when test acc reaches this

@@ -96,3 +96,48 @@ def test_tp_matches_single_device(eight_devices):
     for a, b in zip(jax.tree.leaves(ref_state.params), jax.tree.leaves(tp_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
     assert int(tp_state.step) == len(batches)
+
+
+def test_trainer_tp_matches_single_device(eight_devices, tmp_path):
+    """Config-driven TP (RunConfig.tp): a dp=2 x tp=4 Trainer reproduces the
+    single-device parameter trajectory (same seed => same math under GSPMD)
+    and its checkpoint restores into a single-device trainer."""
+    import numpy as np
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="mlp", model_kwargs={"hidden": (128, 128), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=1024, n_test=256,
+        batch_size=128, epochs=2, lr=2e-3, quiet=True, seed=3,
+        checkpoint_dir=str(tmp_path / "tp_ck"),
+    )
+    t_tp = Trainer(RunConfig(name="tp", dp=2, tp=4, **base))
+    s_tp = t_tp.fit()  # saves at exit
+    t_1 = Trainer(RunConfig(name="one", dp=1, **{**base, "checkpoint_dir": None}))
+    t_1.fit()
+
+    a, b = jax.device_get((t_tp.state.params, t_1.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=5e-4)
+    assert np.isfinite(s_tp["best_test_accuracy"])
+
+    # TP checkpoint -> single-device resume (cross-layout, SURVEY.md §5)
+    t_r = Trainer(RunConfig(name="r", dp=1, **base))
+    restored = t_r.restore_checkpoint()
+    assert restored == 2 * t_tp.steps_per_epoch
+    for x, y in zip(jax.tree.leaves(jax.device_get(t_tp.state.params)),
+                    jax.tree.leaves(jax.device_get(t_r.state.params))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_trainer_tp_rejects_stream_mode(eight_devices):
+    import pytest
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    with pytest.raises(ValueError, match="stream.*tp"):
+        Trainer(RunConfig(model="mlp", synthetic=True, n_train=256, n_test=64,
+                          batch_size=32, tp=2, input_mode="stream", quiet=True))
