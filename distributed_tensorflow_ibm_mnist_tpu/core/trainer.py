@@ -97,9 +97,12 @@ class Trainer:
         self.num_classes = data["num_classes"]
 
         self.tp = max(1, config.tp)
-        self.dp = config.dp if config.dp else max(1, len(jax.devices()) // self.tp)
-        if mesh is None and (self.dp > 1 or self.tp > 1):
-            mesh = make_mesh(dp=self.dp, tp=self.tp)
+        self.sp = max(1, config.sp)
+        self.dp = config.dp if config.dp else max(
+            1, len(jax.devices()) // (self.tp * self.sp)
+        )
+        if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1):
+            mesh = make_mesh(dp=self.dp, tp=self.tp, sp=self.sp)
         self.mesh = mesh
 
         n_train = data["train_images"].shape[0]
@@ -111,11 +114,24 @@ class Trainer:
         total_steps = self.steps_per_epoch * config.epochs
 
         model_kwargs = dict(config.model_kwargs)
-        if self.dp > 1 and self.tp == 1 and model_accepts(config.model, "axis_name"):
+        if self.dp > 1 and self.tp == 1 and self.sp == 1 and model_accepts(config.model, "axis_name"):
             # cross-replica BatchNorm: global-batch moments via pmean over ICI.
             # (The TP path runs under GSPMD, where there is no named axis and
             # BN moments are already semantically global.)
             model_kwargs.setdefault("axis_name", "data")
+        if self.sp > 1:
+            # sequence parallelism: shard the model's attention over 'seq'
+            # with a ring-attention island (SURVEY.md §5 long-context row)
+            if not model_accepts(config.model, "attn_fn"):
+                raise ValueError(
+                    f"sp={self.sp} needs a sequence model taking attn_fn "
+                    f"(e.g. 'vit'); got {config.model!r}"
+                )
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
+                make_ring_attention,
+            )
+
+            model_kwargs.setdefault("attn_fn", make_ring_attention(self.mesh))
         self.model = get_model(
             config.model, num_classes=self.num_classes, **model_kwargs
         )
@@ -129,8 +145,8 @@ class Trainer:
         if config.input_mode not in ("device", "stream"):
             raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
         self._stream = config.input_mode == "stream"
-        if self._stream and self.tp > 1:
-            raise ValueError("input_mode='stream' does not compose with tp>1; use device mode")
+        if self._stream and (self.tp > 1 or self.sp > 1):
+            raise ValueError("input_mode='stream' does not compose with tp/sp>1; use device mode")
         step_kw = dict(
             label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
             remat=config.remat, grad_accum=config.grad_accum,
@@ -162,8 +178,9 @@ class Trainer:
                 self._train_chunk = jax.jit(
                     make_chunk_runner(self.model, self.tx, **step_kw), donate_argnums=(0,)
                 )
-        elif self.tp > 1:
-            # DP x TP under GSPMD: Megatron specs on dense stacks, dataset
+        elif self.tp > 1 or self.sp > 1:
+            # DP x TP (x SP) under GSPMD: Megatron specs on dense stacks
+            # (replicated when tp=1), ring-attention islands when sp>1, dataset
             # sharded over 'data', the whole epoch one jitted scan — same
             # shape as the other paths, only shardings differ.
             from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (

@@ -49,6 +49,8 @@ class RunConfig:
     dp: int = 1  # data-parallel degree; 0 => all visible devices (divided by tp first)
     tp: int = 1  # tensor-parallel degree over the 'model' mesh axis (GSPMD
     #              Megatron specs on dense_{i} stacks; composes with dp)
+    sp: int = 1  # sequence-parallel degree over the 'seq' mesh axis (ring
+    #              attention; model must accept attn_fn, e.g. 'vit')
     # run control
     seed: int = 0
     target_accuracy: float | None = None  # stop early when test acc reaches this
