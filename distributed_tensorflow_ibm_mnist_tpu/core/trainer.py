@@ -237,7 +237,7 @@ class Trainer:
         if self._ckpt is None:
             raise ValueError("no checkpoint_dir configured")
         restored = self._ckpt.restore(jax.device_get(self.state), step=step)
-        if self.tp > 1:
+        if self.tp > 1 or self.sp > 1:  # must mirror __init__'s GSPMD branch
             from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
                 shard_train_state,
             )

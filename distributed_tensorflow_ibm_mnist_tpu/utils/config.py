@@ -46,7 +46,7 @@ class RunConfig:
     stream_chunk: int = 8  # stream mode: batches per host->device transfer (1 = per-step);
     #                        each chunk is one compiled scan, amortizing transfer latency
     # parallelism
-    dp: int = 1  # data-parallel degree; 0 => all visible devices (divided by tp first)
+    dp: int = 1  # data-parallel degree; 0 => all visible devices (divided by tp*sp first)
     tp: int = 1  # tensor-parallel degree over the 'model' mesh axis (GSPMD
     #              Megatron specs on dense_{i} stacks; composes with dp)
     sp: int = 1  # sequence-parallel degree over the 'seq' mesh axis (ring

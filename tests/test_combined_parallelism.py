@@ -82,6 +82,7 @@ def test_trainer_config_driven_dp_tp_sp(eight_devices):
                       "dtype": jnp.float32},
         dataset="mnist", synthetic=True, n_train=512, n_test=128,
         batch_size=64, epochs=2, lr=1e-3, dp=2, tp=2, sp=2, quiet=True,
+        eval_batch_size=128,
     )
     t = Trainer(cfg)
     assert t.mesh.shape == {"data": 2, "model": 2, "seq": 2, "pipe": 1}
@@ -102,3 +103,27 @@ def test_trainer_sp_requires_sequence_model(eight_devices):
     with pytest.raises(ValueError, match="attn_fn"):
         Trainer(RunConfig(model="lenet5", synthetic=True, n_train=256, n_test=64,
                           batch_size=32, sp=2, quiet=True))
+
+
+def test_trainer_sp_checkpoint_resume(eight_devices, tmp_path):
+    """sp>1 (tp=1) checkpoint resume must re-shard onto the mesh, not commit
+    the state to one device (regression: restore only checked tp)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="sp_ck", model="vit",
+        model_kwargs={"patch_size": 7, "dim": 32, "depth": 2, "heads": 2,
+                      "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=128, n_test=32,
+        batch_size=32, epochs=1, lr=1e-3, dp=1, tp=1, sp=2, quiet=True,
+        eval_batch_size=32, checkpoint_dir=str(tmp_path / "spck"),
+    )
+    t1 = Trainer(cfg)
+    t1.fit()
+    t2 = Trainer(cfg.replace(resume=True))
+    t2.fit()  # restores, then trains another epoch on the mesh-jitted runner
+    assert int(jax.device_get(t2.state.step)) == 2 * t2.steps_per_epoch

@@ -110,7 +110,7 @@ def test_trainer_tp_matches_single_device(eight_devices, tmp_path):
     base = dict(
         model="mlp", model_kwargs={"hidden": (128, 128), "dtype": jnp.float32},
         dataset="mnist", synthetic=True, n_train=1024, n_test=256,
-        batch_size=128, epochs=2, lr=2e-3, quiet=True, seed=3,
+        batch_size=128, epochs=2, lr=2e-3, quiet=True, seed=3, eval_batch_size=256,
         checkpoint_dir=str(tmp_path / "tp_ck"),
     )
     t_tp = Trainer(RunConfig(name="tp", dp=2, tp=4, **base))

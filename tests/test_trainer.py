@@ -68,8 +68,8 @@ def test_batch_larger_than_dataset_raises():
 
 def test_dp_resnet_gets_cross_replica_bn(eight_devices):
     cfg = RunConfig(
-        model="resnet20", synthetic=True, n_train=256, n_test=64,
-        batch_size=64, epochs=1, dp=8, quiet=True,
+        model="resnet20", synthetic=True, n_train=128, n_test=64,
+        batch_size=64, epochs=1, dp=8, quiet=True, eval_batch_size=64,
     )
     t = Trainer(cfg)
     assert t.model.axis_name == "data"
