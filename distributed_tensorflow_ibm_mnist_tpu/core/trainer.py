@@ -163,7 +163,6 @@ class Trainer:
                     make_dp_train_step,
                 )
 
-                state = replicate(self.mesh, state)
                 self._train_step = make_dp_train_step(self.model, self.tx, self.mesh, **step_kw)
                 self._train_chunk = make_dp_chunk_runner(self.model, self.tx, self.mesh, **step_kw)
             else:
@@ -198,12 +197,10 @@ class Trainer:
             self.train_images, self.train_labels = shard_dataset(
                 self.mesh, data["train_images"], data["train_labels"]
             )
-            state = shard_train_state(self.mesh, state, self._tp_specs)
         elif self.dp > 1:
             self.train_images, self.train_labels = shard_dataset(
                 self.mesh, data["train_images"], data["train_labels"]
             )
-            state = replicate(self.mesh, state)
             self._run_epoch = make_dp_epoch_runner(
                 self.model, self.tx, config.batch_size, self.mesh, **step_kw
             )
@@ -218,7 +215,7 @@ class Trainer:
         self.test_images = jax.device_put(data["test_images"])
         self.test_labels = jax.device_put(data["test_labels"])
         self._eval = jax.jit(make_eval_fn(self.model, config.eval_batch_size))
-        self.state = state
+        self.state = self._place_state(state)
         self.history: list[dict[str, Any]] = []
 
         self._ckpt = None
@@ -226,6 +223,20 @@ class Trainer:
             from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
 
             self._ckpt = CheckpointManager(config.checkpoint_dir)
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Place a host/unplaced TrainState per this trainer's layout — the
+        ONE spot encoding shard-vs-replicate-vs-local, used at build and at
+        every checkpoint restore (so the two can't drift)."""
+        if self.tp > 1 or self.sp > 1:
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+                shard_train_state,
+            )
+
+            return shard_train_state(self.mesh, state, self._tp_specs)
+        if self.dp > 1:
+            return replicate(self.mesh, state)
+        return jax.device_put(state)
 
     def save_checkpoint(self, wait: bool = True) -> int | None:
         if self._ckpt is None:
@@ -237,17 +248,7 @@ class Trainer:
         if self._ckpt is None:
             raise ValueError("no checkpoint_dir configured")
         restored = self._ckpt.restore(jax.device_get(self.state), step=step)
-        if self.tp > 1 or self.sp > 1:  # must mirror __init__'s GSPMD branch
-            from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
-                shard_train_state,
-            )
-
-            restored = shard_train_state(self.mesh, restored, self._tp_specs)
-        elif self.dp > 1:
-            restored = replicate(self.mesh, restored)
-        else:
-            restored = jax.device_put(restored)
-        self.state = restored
+        self.state = self._place_state(restored)
         return int(jax.device_get(self.state.step))
 
     def _run_epoch_stream(self, state, epoch_rng):
@@ -323,7 +324,7 @@ class Trainer:
         if cfg.resume and self._ckpt is not None and self._ckpt.latest_step() is not None:
             step = self.restore_checkpoint()
             self.writer.write("resume", step=step)
-        chips = max(1, self.dp) * max(1, self.tp)
+        chips = max(1, self.dp) * max(1, self.tp) * max(1, self.sp)
         # Step base for metric records: nonzero after a checkpoint resume
         # (the epoch counter restarts at 0 but state.step does not).
         step0 = int(jax.device_get(self.state.step))

@@ -34,6 +34,12 @@ _MNIST_CACHE_DIRS = [
 ]
 
 
+def _cache_dirs() -> list[str]:
+    """Search path for dataset caches; $DTM_DATA_DIR (if set) wins."""
+    env = os.environ.get("DTM_DATA_DIR")
+    return ([env] if env else []) + _MNIST_CACHE_DIRS
+
+
 def _read_idx(path: Path) -> np.ndarray:
     """Parse an (optionally gzipped) IDX file (the MNIST wire format)."""
     opener = gzip.open if path.suffix == ".gz" else open
@@ -47,7 +53,7 @@ def _read_idx(path: Path) -> np.ndarray:
 
 
 def _find_file(names: list[str]) -> Path | None:
-    for d in _MNIST_CACHE_DIRS:
+    for d in _cache_dirs():
         for name in names:
             p = Path(os.path.expanduser(d)) / name
             if p.exists():
@@ -88,7 +94,7 @@ def _try_real_mnist(prefix: str = "") -> dict[str, np.ndarray] | None:
 
 
 def _try_real_cifar10() -> dict[str, np.ndarray] | None:
-    for d in _MNIST_CACHE_DIRS:
+    for d in _cache_dirs():
         root = Path(os.path.expanduser(d)) / "cifar-10-batches-py"
         if not root.exists():
             continue

@@ -1,0 +1,105 @@
+"""Real-cache dataset loading (IDX / keras-npz), exercised via $DTM_DATA_DIR.
+
+The reference consumed MNIST through ``input_data.read_data_sets`` (IDX wire
+format, SURVEY.md §2.1 "Data input"); these tests fabricate valid caches in a
+tmp dir and check the loader prefers them over the synthetic fallback.
+"""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+
+def _write_idx_images(path, arr):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.tobytes())
+
+
+@pytest.fixture
+def fake_mnist_idx(tmp_path, monkeypatch):
+    # hermetic: only DTM_DATA_DIR is searched (a real ~/.keras mnist.npz
+    # would otherwise outrank the fixture's IDX files)
+    monkeypatch.setattr(
+        "distributed_tensorflow_ibm_mnist_tpu.data.loaders._MNIST_CACHE_DIRS", [], raising=True
+    )
+    rng = np.random.default_rng(0)
+    tr_img = rng.integers(0, 255, (64, 28, 28), dtype=np.uint8)
+    tr_lab = rng.integers(0, 10, (64,)).astype(np.uint8)
+    te_img = rng.integers(0, 255, (16, 28, 28), dtype=np.uint8)
+    te_lab = rng.integers(0, 10, (16,)).astype(np.uint8)
+    _write_idx_images(tmp_path / "train-images-idx3-ubyte.gz", tr_img)
+    _write_idx_labels(tmp_path / "train-labels-idx1-ubyte.gz", tr_lab)
+    _write_idx_images(tmp_path / "t10k-images-idx3-ubyte.gz", te_img)
+    _write_idx_labels(tmp_path / "t10k-labels-idx1-ubyte.gz", te_lab)
+    monkeypatch.setenv("DTM_DATA_DIR", str(tmp_path))
+    return tr_img, tr_lab, te_img, te_lab
+
+
+def test_idx_cache_loads_real_mnist(fake_mnist_idx):
+    from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+
+    tr_img, tr_lab, te_img, te_lab = fake_mnist_idx
+    d = load_dataset("mnist", synthetic=False)
+    assert d["train_images"].shape == (64, 28, 28, 1)
+    np.testing.assert_array_equal(d["train_images"][..., 0], tr_img)
+    np.testing.assert_array_equal(d["train_labels"], tr_lab.astype(np.int32))
+    np.testing.assert_array_equal(d["test_images"][..., 0], te_img)
+
+
+def test_default_prefers_real_cache_over_synthetic(fake_mnist_idx):
+    from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+
+    d = load_dataset("mnist", synthetic=None)  # auto: real first
+    np.testing.assert_array_equal(d["train_images"][..., 0], fake_mnist_idx[0])
+
+
+def test_npz_cache_loads(tmp_path, monkeypatch):
+    from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+
+    rng = np.random.default_rng(1)
+    x_train = rng.integers(0, 255, (32, 28, 28), dtype=np.uint8)
+    y_train = rng.integers(0, 10, (32,)).astype(np.uint8)
+    x_test = rng.integers(0, 255, (8, 28, 28), dtype=np.uint8)
+    y_test = rng.integers(0, 10, (8,)).astype(np.uint8)
+    np.savez(tmp_path / "mnist.npz", x_train=x_train, y_train=y_train,
+             x_test=x_test, y_test=y_test)
+    monkeypatch.setenv("DTM_DATA_DIR", str(tmp_path))
+    monkeypatch.setattr(
+        "distributed_tensorflow_ibm_mnist_tpu.data.loaders._MNIST_CACHE_DIRS", [], raising=True
+    )
+    d = load_dataset("mnist", synthetic=False)
+    np.testing.assert_array_equal(d["train_images"][..., 0], x_train)
+
+
+def test_missing_real_cache_raises(tmp_path, monkeypatch):
+    from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+
+    monkeypatch.setenv("DTM_DATA_DIR", str(tmp_path / "empty"))
+    monkeypatch.setattr(
+        "distributed_tensorflow_ibm_mnist_tpu.data.loaders._MNIST_CACHE_DIRS", [], raising=True
+    )
+    with pytest.raises(FileNotFoundError):
+        load_dataset("mnist", synthetic=False)
+
+
+def test_corrupt_cache_falls_back_to_synthetic(tmp_path, monkeypatch):
+    from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+
+    (tmp_path / "mnist.npz").write_bytes(b"not a real npz")
+    monkeypatch.setenv("DTM_DATA_DIR", str(tmp_path))
+    monkeypatch.setattr(
+        "distributed_tensorflow_ibm_mnist_tpu.data.loaders._MNIST_CACHE_DIRS", [], raising=True
+    )
+    d = load_dataset("mnist", synthetic=None, n_train=128, n_test=32)
+    assert d["train_images"].shape[0] == 128  # synthetic fallback took over
