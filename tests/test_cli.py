@@ -54,3 +54,11 @@ def test_parallel_subpackage_imports_standalone():
     )
     assert proc.returncode == 0, proc.stderr
     assert "ok" in proc.stdout
+
+
+def test_build_config_parallelism_overrides():
+    from distributed_tensorflow_ibm_mnist_tpu.launch.cli import build_config
+
+    cfg = build_config(["--preset", "mnist_mlp_smoke", "--set", "dp=2",
+                        "--set", "tp=2", "--set", "sp=2"])
+    assert (cfg.dp, cfg.tp, cfg.sp) == (2, 2, 2)
