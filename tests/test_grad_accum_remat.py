@@ -85,7 +85,8 @@ def test_vit_flash_by_name():
     cfg = RunConfig(
         model="vit",
         model_kwargs={"patch_size": 7, "dim": 32, "depth": 1, "heads": 2, "attn": "flash"},
-        synthetic=True, n_train=256, n_test=64, batch_size=64, epochs=1, quiet=True,
+        synthetic=True, n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32,
     )
     summary = Trainer(cfg).fit()
     assert np.isfinite(summary["best_test_accuracy"])
