@@ -1,0 +1,98 @@
+"""Train a long-context retrieval transformer with causal flash attention.
+
+The long-context path end to end: a decoder-style transformer stack
+(`models/transformer.py::TransformerBlock` with the streaming Pallas
+flash kernel as its ``attn_fn``, ``causal=True``, O(tile) VMEM — S=32k
+fits one v5e chip) trained on a task that is IMPOSSIBLE without
+long-range attention: token 0 is a random key, every other input token
+is noise, and the label at position t is ``(key + t) mod V``.  A model
+that cannot attend ~1000 positions back to token 0 is stuck at the
+uniform -log(1/V) loss floor; the causal flash kernel drives it to ~0.
+On a multi-device mesh, swap the attention for
+``make_ring_attention(mesh, causal=True)`` or
+``make_ulysses_attention(...)`` — the same drop-in ``attn_fn`` slot.
+
+    python examples/06_causal_lm_long_context.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo-root import without install
+
+import time
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_ibm_mnist_tpu.models.transformer import TransformerBlock
+from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_attention
+
+VOCAB, SEQ, DIM, HEADS, DEPTH = 64, 1024, 128, 4, 2
+BATCH, STEPS = 16, 1500  # the attend-to-key head emerges around step ~500
+
+
+class RetrievalLM(nn.Module):
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(VOCAB, DIM, dtype=jnp.bfloat16)(tokens)
+        pos = self.param("pos", nn.initializers.normal(0.02), (1, SEQ, DIM))
+        x = x + pos.astype(jnp.bfloat16)
+        attn = partial(flash_attention, causal=True)
+        for i in range(DEPTH):
+            x = TransformerBlock(
+                dim=DIM, heads=HEADS, attn_fn=attn, name=f"block_{i}"
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.bfloat16)(x)
+        return nn.Dense(VOCAB, dtype=jnp.bfloat16, name="logits")(x).astype(jnp.float32)
+
+
+def make_batch(rng: np.random.Generator):
+    """tokens: [key, noise, noise, ...]; labels[t] = (key + t) mod V."""
+    key = rng.integers(0, VOCAB, (BATCH, 1))
+    noise = rng.integers(0, VOCAB, (BATCH, SEQ - 1))
+    tokens = np.concatenate([key, noise], axis=1).astype(np.int32)
+    labels = ((key + np.arange(SEQ)[None, :]) % VOCAB).astype(np.int32)
+    return jnp.asarray(tokens), jnp.asarray(labels)
+
+
+if __name__ == "__main__":
+    model = RetrievalLM()
+    rng = np.random.default_rng(0)
+    tokens, labels = make_batch(rng)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    tx = optax.adam(optax.warmup_cosine_decay_schedule(0.0, 5e-3, 50, STEPS))
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, tokens, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, opt2 = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt2, loss
+
+    print(f"retrieval LM: vocab {VOCAB}, seq {SEQ}, {DEPTH} blocks, causal flash attention")
+    print(f"no-attention models are stuck at the {np.log(VOCAB):.3f} uniform loss floor")
+    # warm the compile outside the timed region (repo convention, bench.py)
+    params, opt, loss = step(params, opt, tokens, labels)
+    jax.device_get(loss)
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        params, opt, loss = step(params, opt, *make_batch(rng))
+        if (i + 1) % 300 == 0:
+            print(f"step {i+1}: loss {float(jax.device_get(loss)):.4f}")
+    wall = time.perf_counter() - t0
+    tok_s = STEPS * BATCH * SEQ / wall
+    final = float(jax.device_get(loss))
+    verdict = (
+        "<< floor: every position retrieved the key from ~1000 tokens back"
+        if final < 1.0 else "still descending"
+    )
+    print(f"\n{tok_s/1e3:.0f}k tokens/sec (excl compile); final loss {final:.3f} ({verdict})")
