@@ -104,6 +104,14 @@ class Trainer:
         if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1):
             mesh = make_mesh(dp=self.dp, tp=self.tp, sp=self.sp)
         self.mesh = mesh
+        if config.fsdp and self.dp <= 1:
+            raise ValueError(
+                "fsdp=True needs dp>1 (ZeRO-3 shards over the 'data' axis); "
+                f"got dp={self.dp}"
+            )
+        # FSDP and TP/SP all run under the same GSPMD epoch runner; only the
+        # param spec tree differs (fsdp shards over 'data', tp over 'model').
+        self._gspmd = self.tp > 1 or self.sp > 1 or config.fsdp
 
         n_train = data["train_images"].shape[0]
         self.steps_per_epoch = n_train // config.batch_size
@@ -114,10 +122,10 @@ class Trainer:
         total_steps = self.steps_per_epoch * config.epochs
 
         model_kwargs = dict(config.model_kwargs)
-        if self.dp > 1 and self.tp == 1 and self.sp == 1 and model_accepts(config.model, "axis_name"):
+        if self.dp > 1 and not self._gspmd and model_accepts(config.model, "axis_name"):
             # cross-replica BatchNorm: global-batch moments via pmean over ICI.
-            # (The TP path runs under GSPMD, where there is no named axis and
-            # BN moments are already semantically global.)
+            # (GSPMD paths — tp/sp/fsdp — have no named axis, and BN moments
+            # are already semantically global there.)
             model_kwargs.setdefault("axis_name", "data")
         if self.sp > 1:
             # sequence parallelism: shard the model's attention over 'seq'
@@ -145,8 +153,8 @@ class Trainer:
         if config.input_mode not in ("device", "stream"):
             raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
         self._stream = config.input_mode == "stream"
-        if self._stream and (self.tp > 1 or self.sp > 1):
-            raise ValueError("input_mode='stream' does not compose with tp/sp>1; use device mode")
+        if self._stream and self._gspmd:
+            raise ValueError("input_mode='stream' does not compose with tp/sp/fsdp; use device mode")
         step_kw = dict(
             label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
             remat=config.remat, grad_accum=config.grad_accum,
@@ -177,7 +185,7 @@ class Trainer:
                 self._train_chunk = jax.jit(
                     make_chunk_runner(self.model, self.tx, **step_kw), donate_argnums=(0,)
                 )
-        elif self.tp > 1 or self.sp > 1:
+        elif self._gspmd:
             # DP x TP (x SP) under GSPMD: Megatron specs on dense stacks
             # (replicated when tp=1), ring-attention islands when sp>1, dataset
             # sharded over 'data', the whole epoch one jitted scan — same
@@ -186,10 +194,19 @@ class Trainer:
                 make_param_specs,
                 make_tp_epoch_runner,
                 megatron_dense_rule,
-                shard_train_state,
             )
 
-            self._tp_specs = make_param_specs(state.params, megatron_dense_rule())
+            if config.fsdp:
+                # ZeRO-3: params + opt state sharded over 'data'; with tp>1
+                # the Megatron dims are kept and FSDP shards the remainder
+                from distributed_tensorflow_ibm_mnist_tpu.parallel.fsdp import make_fsdp_specs
+
+                self._tp_specs = make_fsdp_specs(
+                    state.params, self.mesh,
+                    base_rule=megatron_dense_rule() if self.tp > 1 else None,
+                )
+            else:
+                self._tp_specs = make_param_specs(state.params, megatron_dense_rule())
             self._run_epoch = make_tp_epoch_runner(
                 self.model, self.tx, self.mesh, self._tp_specs, state,
                 config.batch_size, **step_kw,
@@ -228,7 +245,7 @@ class Trainer:
         """Place a host/unplaced TrainState per this trainer's layout — the
         ONE spot encoding shard-vs-replicate-vs-local, used at build and at
         every checkpoint restore (so the two can't drift)."""
-        if self.tp > 1 or self.sp > 1:
+        if self._gspmd:
             from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
                 shard_train_state,
             )

@@ -51,6 +51,8 @@ class RunConfig:
     #              Megatron specs on dense_{i} stacks; composes with dp)
     sp: int = 1  # sequence-parallel degree over the 'seq' mesh axis (ring
     #              attention; model must accept attn_fn, e.g. 'vit')
+    fsdp: bool = False  # ZeRO-3: shard params + opt state over 'data' (needs
+    #                     dp>1; composes with tp into the 2D TP-within layout)
     # run control
     seed: int = 0
     target_accuracy: float | None = None  # stop early when test acc reaches this
