@@ -82,3 +82,38 @@ def test_pallas_kernels_on_real_tpu():
     )
     assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
     assert "TPU_KERNELS_OK" in proc.stdout
+
+
+GOLDEN = r'''
+import jax
+assert jax.devices()[0].platform == "tpu", jax.devices()
+from distributed_tensorflow_ibm_mnist_tpu.core import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import get_preset
+cfg = get_preset("mnist_lenet_1chip").replace(
+    batch_size=1024, lr=4e-3, schedule="cosine", epochs=10,
+    target_accuracy=0.99, quiet=True,
+)
+s = Trainer(cfg).fit()
+assert s["best_test_accuracy"] >= 0.99, s
+assert s["time_to_target_s"] is not None and s["time_to_target_s"] < 60.0, s
+assert s["images_per_sec_per_chip"] > 50_000, s
+print("GOLDEN_OK", s["best_test_accuracy"], s["images_per_sec_per_chip"], flush=True)
+'''
+
+
+@pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
+def test_lenet_golden_metric_on_tpu():
+    """SURVEY.md §4 golden-metric job: the [B:8] LeNet config on the real
+    chip must reach 99% inside the 60s north-star budget at sane throughput."""
+    probe = subprocess.run(
+        [sys.executable, "-c", PROBE], capture_output=True, text=True,
+        timeout=120, cwd=str(REPO), env=_default_env(),
+    )
+    if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
+        pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
+    proc = subprocess.run(
+        [sys.executable, "-c", GOLDEN], capture_output=True, text=True,
+        timeout=560, cwd=str(REPO), env=_default_env(),
+    )
+    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
+    assert "GOLDEN_OK" in proc.stdout
