@@ -193,7 +193,7 @@ class Trainer:
             from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
                 make_param_specs,
                 make_tp_epoch_runner,
-                megatron_dense_rule,
+                megatron_rule,
             )
 
             if config.fsdp:
@@ -203,10 +203,10 @@ class Trainer:
 
                 self._tp_specs = make_fsdp_specs(
                     state.params, self.mesh,
-                    base_rule=megatron_dense_rule() if self.tp > 1 else None,
+                    base_rule=megatron_rule(self.tp) if self.tp > 1 else None,
                 )
             else:
-                self._tp_specs = make_param_specs(state.params, megatron_dense_rule())
+                self._tp_specs = make_param_specs(state.params, megatron_rule(self.tp))
             self._run_epoch = make_tp_epoch_runner(
                 self.model, self.tx, self.mesh, self._tp_specs, state,
                 config.batch_size, **step_kw,

@@ -68,6 +68,72 @@ def megatron_dense_rule(axis: str = "model") -> SpecRule:
     return rule
 
 
+def megatron_rule(n_shards: int, axis: str = "model") -> SpecRule:
+    """Full-model Megatron sharding: attention, convs, and the head too.
+
+    Extends :func:`megatron_dense_rule` (which only touches ``dense_{i}``
+    stacks) to every parameter family in the zoo, with divisibility guarded
+    by ``n_shards`` so indivisible leaves degrade to replicated instead of
+    failing at placement:
+
+    * ``dense_{i}`` — the alternating column/row pair (unchanged).
+    * ``qkv`` — column-parallel ``P(None, axis)`` (fused q/k/v output
+      features sharded; bias sharded to match), the Megatron attention
+      pattern on a fused projection.
+    * ``proj`` (2-D, the attention output) — row-parallel ``P(axis, None)``;
+      together with ``qkv`` the attention block has one reduction, mirroring
+      the MLP pair.
+    * 4-D conv kernels (HWIO) — output channels sharded
+      ``P(None, None, None, axis)`` where divisible; ResNet/LeNet convs and
+      the ViT patch embed all land here (a 4-D ``proj`` is ResNet's 1x1
+      shortcut conv, not attention).
+    * ``fc{i}`` — column-parallel (LeNet's fc1024; its following ``logits``
+      row closes the pair).
+    * ``logits`` — row-parallel ``P(axis, None)``: the class count (10) never
+      divides a mesh axis, but the input features do, so the head's matmul
+      shards over the contraction dim with one psum.
+
+    Everything else (norm scales/biases, pos embeds, conv biases) stays
+    replicated — tiny leaves where a gather would cost more than it saves.
+    Correctness never depends on these hints (GSPMD reshards as needed);
+    they decide how much of the FLOPs actually run ``n_shards``-wide.
+    """
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    dense = megatron_dense_rule(axis)
+
+    def rule(path: tuple[str, ...], leaf) -> P:
+        ndim = getattr(leaf, "ndim", 0)
+        shape = getattr(leaf, "shape", ())
+        base = dense(path, leaf)
+        if base != P():
+            divisible = all(
+                ax is None or shape[i] % n_shards == 0 for i, ax in enumerate(base)
+            )
+            return base if divisible else P()
+        if len(path) < 2:
+            return P()
+        name, kind = path[-2], path[-1]
+        if kind == "kernel" and ndim == 4 and shape[3] % n_shards == 0:
+            return P(None, None, None, axis)  # conv output channels
+        if kind == "kernel" and ndim == 2:
+            d_in, d_out = shape
+            if name == "qkv" and d_out % n_shards == 0:
+                return P(None, axis)
+            if name == "proj" and d_in % n_shards == 0:
+                return P(axis, None)
+            if re.fullmatch(r"fc\d*", name) and d_out % n_shards == 0:
+                return P(None, axis)
+            if name == "logits" and d_in % n_shards == 0:
+                return P(axis, None)
+        if kind == "bias" and ndim == 1 and shape[0] % n_shards == 0:
+            if name == "qkv" or re.fullmatch(r"fc\d*", name):
+                return P(axis)  # match the column-parallel output sharding
+        return P()
+
+    return rule
+
+
 def make_param_specs(params, rule: SpecRule):
     """Apply a spec rule over the param tree -> congruent PartitionSpec tree."""
     return jax.tree_util.tree_map_with_path(
