@@ -39,4 +39,8 @@ def make_optimizer(config: RunConfig, total_steps: int) -> optax.GradientTransfo
         raise ValueError(f"unknown optimizer {config.optimizer!r}")
     if config.weight_decay and config.optimizer in ("sgd", "momentum", "adam"):
         tx = optax.chain(optax.add_decayed_weights(config.weight_decay), tx)
+    if config.grad_clip:
+        # outermost: clip the raw (already cross-replica-reduced) gradients
+        # before decay/optimizer see them
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip), tx)
     return tx

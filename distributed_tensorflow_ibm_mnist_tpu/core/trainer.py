@@ -10,6 +10,7 @@ wall-clock-to-target-accuracy).
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Any
@@ -129,17 +130,31 @@ class Trainer:
             model_kwargs.setdefault("axis_name", "data")
         if self.sp > 1:
             # sequence parallelism: shard the model's attention over 'seq'
-            # with a ring-attention island (SURVEY.md §5 long-context row)
+            # (SURVEY.md §5 long-context row); strategy picked by sp_impl
             if not model_accepts(config.model, "attn_fn"):
                 raise ValueError(
                     f"sp={self.sp} needs a sequence model taking attn_fn "
                     f"(e.g. 'vit'); got {config.model!r}"
                 )
+            model_kwargs.setdefault("attn_fn", self._make_sp_attn(model_kwargs))
+        elif config.causal and model_accepts(config.model, "attn_fn"):
+            # causal without sp: same mask through the single-device kernel
             from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
-                make_ring_attention,
+                vanilla_attention,
             )
 
-            model_kwargs.setdefault("attn_fn", make_ring_attention(self.mesh))
+            if model_kwargs.get("attn") == "flash":
+                from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import (
+                    flash_attention,
+                )
+
+                model_kwargs.setdefault(
+                    "attn_fn", functools.partial(flash_attention, causal=True)
+                )
+            else:
+                model_kwargs.setdefault(
+                    "attn_fn", functools.partial(vanilla_attention, causal=True)
+                )
         self.model = get_model(
             config.model, num_classes=self.num_classes, **model_kwargs
         )
@@ -240,6 +255,34 @@ class Trainer:
             from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
 
             self._ckpt = CheckpointManager(config.checkpoint_dir)
+
+    def _make_sp_attn(self, model_kwargs: dict):
+        """The sp>1 attention island per config: ring or Ulysses, causal
+        plumbed through (VERDICT.md round-1 weak items 6/8)."""
+        cfg = self.config
+        if cfg.sp_impl == "ring":
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
+                make_ring_attention,
+            )
+
+            return make_ring_attention(self.mesh, causal=cfg.causal)
+        if cfg.sp_impl == "ulysses":
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
+                vanilla_attention,
+            )
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.sequence_parallel import (
+                make_ulysses_attention,
+            )
+
+            inner = vanilla_attention
+            if model_kwargs.get("attn") == "flash":
+                from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import (
+                    flash_attention,
+                )
+
+                inner = flash_attention
+            return make_ulysses_attention(self.mesh, causal=cfg.causal, inner_attn=inner)
+        raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}; use 'ring' or 'ulysses'")
 
     def _place_state(self, state: TrainState) -> TrainState:
         """Place a host/unplaced TrainState per this trainer's layout — the
@@ -460,7 +503,8 @@ class Trainer:
             "target_accuracy": cfg.target_accuracy,
             "images_per_sec": round(images / steady_mean, 1),
             "images_per_sec_per_chip": round(images / steady_mean / chips, 1),
-            "param_count": self.state.param_count() if self.dp == 1 else None,
+            # global leaf sizes: layout-independent, valid at any dp/tp/sp
+            "param_count": self.state.param_count(),
         }
         if preempted:
             summary["preempted"] = True

