@@ -244,9 +244,24 @@ class Trainer:
                 donate_argnums=(0,),
             )
 
-        self.test_images = jax.device_put(data["test_images"])
-        self.test_labels = jax.device_put(data["test_labels"])
-        self._eval = jax.jit(make_eval_fn(self.model, config.eval_batch_size))
+        if self.mesh is not None:
+            # parallel eval: test set sharded over 'data', each scanned batch
+            # constrained to that axis — eval uses every chip of the run's own
+            # mesh (chief-only eval idled dp-1 of them; VERDICT.md item 3)
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+                shard_eval_set,
+            )
+
+            self.test_images, self.test_labels, n_test_valid = shard_eval_set(
+                self.mesh, data["test_images"], data["test_labels"]
+            )
+            self._eval = jax.jit(make_eval_fn(
+                self.model, config.eval_batch_size, n_valid=n_test_valid, mesh=self.mesh,
+            ))
+        else:
+            self.test_images = jax.device_put(data["test_images"])
+            self.test_labels = jax.device_put(data["test_labels"])
+            self._eval = jax.jit(make_eval_fn(self.model, config.eval_batch_size))
         self.state = self._place_state(state)
         self.history: list[dict[str, Any]] = []
 

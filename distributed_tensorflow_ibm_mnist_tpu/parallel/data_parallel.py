@@ -64,6 +64,33 @@ def shard_dataset(mesh: Mesh, images: np.ndarray, labels: np.ndarray, axis: str 
     return imgs, labs
 
 
+def shard_eval_set(mesh: Mesh, images: np.ndarray, labels: np.ndarray, axis: str = AXIS):
+    """Place an eval set sharded over ``axis``, zero-PADDED (never dropped).
+
+    Unlike :func:`shard_dataset` (which drops a sub-batch remainder of
+    training data), eval must score every sample — the set is padded up to a
+    multiple of the axis size and the true count returned for the eval fn's
+    mask/denominator (``make_eval_fn(n_valid=...)``).
+
+    Returns ``(images, labels, n_valid)``.
+    """
+    size = mesh.shape[axis]
+    n = images.shape[0]
+    pad = (-n) % size
+    if pad:
+        images = np.pad(images, ((0, pad),) + ((0, 0),) * (images.ndim - 1))
+        labels = np.pad(labels, ((0, pad),))
+    spec_img = P(axis, *([None] * (images.ndim - 1)))
+
+    def _place(host: np.ndarray, spec: P):
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
+        return jax.device_put(host, sharding)
+
+    return _place(images, spec_img), _place(labels, P(axis)), n
+
+
 def replicate(mesh: Mesh, tree):
     """Fully replicate a pytree over the mesh."""
     sharding = NamedSharding(mesh, P())

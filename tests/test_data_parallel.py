@@ -79,3 +79,30 @@ def test_shard_dataset_layout(eight_devices):
     imgs, labs = shard_dataset(mesh, data["train_images"], data["train_labels"])
     assert imgs.shape[0] == 80  # divisible, nothing dropped
     assert len(imgs.sharding.device_set) == 8
+
+
+def test_parallel_eval_sharded_and_matching(eight_devices):
+    """Eval runs under the run's own mesh: the test set is sharded over
+    'data' (padded, never dropped) and metrics equal the single-device eval
+    exactly (VERDICT.md round-1 item 3)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="mlp", model_kwargs={"hidden": (32,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=100,
+        batch_size=32, epochs=1, quiet=True, seed=7, eval_batch_size=48,
+    )
+    t8 = Trainer(RunConfig(name="dp8", dp=8, **base))
+    t1 = Trainer(RunConfig(name="dp1", dp=1, **base))
+
+    # the eval batch really is sharded over 'data'
+    assert t8.test_images.sharding.spec == P("data", None, None, None)
+    assert t8.test_images.shape[0] == 104  # 100 padded up to a multiple of 8
+
+    e8, e1 = t8.evaluate(), t1.evaluate()  # same seed => identical init params
+    assert abs(e8["accuracy"] - e1["accuracy"]) < 1e-6
+    assert abs(e8["loss"] - e1["loss"]) < 1e-5
