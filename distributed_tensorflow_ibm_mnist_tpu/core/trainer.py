@@ -99,20 +99,36 @@ class Trainer:
 
         self.tp = max(1, config.tp)
         self.sp = max(1, config.sp)
+        self.pp = max(1, config.pp)
         self.dp = config.dp if config.dp else max(
-            1, len(jax.devices()) // (self.tp * self.sp)
+            1, len(jax.devices()) // (self.tp * self.sp * self.pp)
         )
-        if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1):
-            mesh = make_mesh(dp=self.dp, tp=self.tp, sp=self.sp)
+        if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1 or self.pp > 1):
+            mesh = make_mesh(dp=self.dp, tp=self.tp, sp=self.sp, pp=self.pp)
         self.mesh = mesh
         if config.fsdp and self.dp <= 1:
             raise ValueError(
                 "fsdp=True needs dp>1 (ZeRO-3 shards over the 'data' axis); "
                 f"got dp={self.dp}"
             )
-        # FSDP and TP/SP all run under the same GSPMD epoch runner; only the
-        # param spec tree differs (fsdp shards over 'data', tp over 'model').
-        self._gspmd = self.tp > 1 or self.sp > 1 or config.fsdp
+        if self.pp > 1 and (self.sp > 1 or config.fsdp):
+            raise ValueError(
+                "pp composes with dp/tp; sp (nested shard_map islands) and "
+                "fsdp do not pipeline yet"
+            )
+        # MoE + dp>1 runs expert-parallel automatically: experts sharded over
+        # 'data', tokens exchanged by all_to_all (VERDICT.md round-1 item 2).
+        self._moe_ep = (
+            self.dp > 1
+            and bool(config.model_kwargs.get("moe_every", 0))
+            and model_accepts(config.model, "moe_fn")
+        )
+        # FSDP/TP/SP/PP/EP all run under the same GSPMD epoch runner; only
+        # the param spec tree differs (fsdp shards over 'data', tp over
+        # 'model', pp over 'pipe', experts over 'data').
+        self._gspmd = (
+            self.tp > 1 or self.sp > 1 or self.pp > 1 or config.fsdp or self._moe_ep
+        )
 
         n_train = data["train_images"].shape[0]
         self.steps_per_epoch = n_train // config.batch_size
@@ -155,6 +171,29 @@ class Trainer:
                 model_kwargs.setdefault(
                     "attn_fn", functools.partial(vanilla_attention, causal=True)
                 )
+        if self.pp > 1:
+            if not model_accepts(config.model, "pipeline_fn"):
+                raise ValueError(
+                    f"pp={self.pp} needs a model with a pipelineable block "
+                    f"stack (pipeline_fn/pp_stages, e.g. 'vit'); got {config.model!r}"
+                )
+            model_kwargs.setdefault("pp_stages", self.pp)
+            model_kwargs.setdefault("pipeline_fn", self._make_pipeline_fn())
+        if self._moe_ep:
+            n_exp = model_kwargs.get("n_experts", 8)
+            if n_exp % self.dp:
+                raise ValueError(
+                    f"expert parallelism needs n_experts ({n_exp}) divisible "
+                    f"by dp ({self.dp})"
+                )
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import (
+                make_moe_dispatch_auto,
+            )
+
+            model_kwargs.setdefault("moe_fn", make_moe_dispatch_auto(
+                self.mesh, n_exp,
+                capacity_factor=model_kwargs.get("moe_capacity_factor", 2.0),
+            ))
         self.model = get_model(
             config.model, num_classes=self.num_classes, **model_kwargs
         )
@@ -169,7 +208,17 @@ class Trainer:
             raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
         self._stream = config.input_mode == "stream"
         if self._stream and self._gspmd:
-            raise ValueError("input_mode='stream' does not compose with tp/sp/fsdp; use device mode")
+            raise ValueError(
+                "input_mode='stream' does not compose with tp/sp/pp/fsdp/"
+                "expert parallelism; use device mode"
+            )
+        if self.pp > 1:
+            m = config.pp_microbatches or self.pp
+            if config.batch_size % (self.dp * m):
+                raise ValueError(
+                    f"batch_size {config.batch_size} must divide dp*microbatches "
+                    f"({self.dp}x{m}) so training always uses the pipeline island"
+                )
         step_kw = dict(
             label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
             remat=config.remat, grad_accum=config.grad_accum,
@@ -206,6 +255,7 @@ class Trainer:
             # sharded over 'data', the whole epoch one jitted scan — same
             # shape as the other paths, only shardings differ.
             from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+                chain_rules,
                 make_param_specs,
                 make_tp_epoch_runner,
                 megatron_rule,
@@ -221,7 +271,23 @@ class Trainer:
                     base_rule=megatron_rule(self.tp) if self.tp > 1 else None,
                 )
             else:
-                self._tp_specs = make_param_specs(state.params, megatron_rule(self.tp))
+                # structural rules (stacked pipe stages, expert dims) first:
+                # the Megatron name rules must not see those leaves
+                rules = []
+                if self.pp > 1:
+                    from distributed_tensorflow_ibm_mnist_tpu.parallel.pipeline import (
+                        pipeline_block_rule,
+                    )
+
+                    rules.append(pipeline_block_rule())
+                if self._moe_ep:
+                    from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import (
+                        moe_expert_rule,
+                    )
+
+                    rules.append(moe_expert_rule())
+                rules.append(megatron_rule(self.tp))
+                self._tp_specs = make_param_specs(state.params, chain_rules(*rules))
             self._run_epoch = make_tp_epoch_runner(
                 self.model, self.tx, self.mesh, self._tp_specs, state,
                 config.batch_size, **step_kw,
@@ -270,6 +336,34 @@ class Trainer:
             from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
 
             self._ckpt = CheckpointManager(config.checkpoint_dir)
+
+    def _make_pipeline_fn(self):
+        """The pp>1 block-stack hook: GPipe island when the batch divides
+        (dp x microbatches), local stage scan otherwise (init samples, eval
+        remainders — GSPMD gathers the pipe-sharded params there, which only
+        non-hot-path shapes ever pay)."""
+        import jax as _jax
+
+        from distributed_tensorflow_ibm_mnist_tpu.parallel.pipeline import (
+            make_pipeline_apply,
+        )
+
+        mesh, dp, m = self.mesh, self.dp, (self.config.pp_microbatches or self.pp)
+
+        def pipeline_fn(stage_fn, stacked_params, x):
+            if x.shape[0] % (dp * m) == 0:
+                island = make_pipeline_apply(
+                    stage_fn, mesh, n_microbatches=m, batch_axis="data",
+                )
+                return island(stacked_params, x)
+
+            def body(c, ps):
+                return stage_fn(ps, c), None
+
+            out, _ = _jax.lax.scan(body, x, stacked_params)
+            return out
+
+        return pipeline_fn
 
     def _make_sp_attn(self, model_kwargs: dict):
         """The sp>1 attention island per config: ring or Ulysses, causal
@@ -399,7 +493,7 @@ class Trainer:
         if cfg.resume and self._ckpt is not None and self._ckpt.latest_step() is not None:
             step = self.restore_checkpoint()
             self.writer.write("resume", step=step)
-        chips = max(1, self.dp) * max(1, self.tp) * max(1, self.sp)
+        chips = max(1, self.dp) * max(1, self.tp) * max(1, self.sp) * max(1, self.pp)
         # Step base for metric records: nonzero after a checkpoint resume
         # (the epoch counter restarts at 0 but state.step does not).
         step0 = int(jax.device_get(self.state.step))

@@ -47,6 +47,7 @@ def make_pipeline_apply(
     n_microbatches: int,
     axis_name: str = AXIS,
     remat: bool = False,
+    batch_axis: str | None = None,
 ):
     """Build ``apply(stage_params, x) -> y`` streaming x through the stages.
 
@@ -55,6 +56,9 @@ def make_pipeline_apply(
     * ``stage_params`` — stacked tree from :func:`stack_stage_params`,
       leaf shape ``(n_stages, ...)``.
     * ``x`` — ``(batch, ...)`` with ``batch`` divisible by ``n_microbatches``.
+    * ``batch_axis`` — mesh axis the batch dim stays sharded over (DP x PP
+      composition: each data shard streams its local batch through its own
+      pipe ring; ``None`` replicates the batch as before).
 
     Returns the full-batch output, replicated over the ``pipe`` axis.
     """
@@ -95,5 +99,23 @@ def make_pipeline_apply(
         return jnp.reshape(outputs, (x.shape[0],) + outputs.shape[2:])
 
     return shard_map_compat(
-        pipelined, mesh, in_specs=(P(axis_name), P()), out_specs=P()
+        pipelined, mesh, in_specs=(P(axis_name), P(batch_axis)), out_specs=P(batch_axis)
     )
+
+
+def pipeline_block_rule(axis: str = AXIS, marker: str = "pipe_blocks"):
+    """Spec rule sharding stacked block-stack params over ``pipe``.
+
+    Matches any leaf whose path passes through the ``marker`` module (the
+    ViT's :class:`~...models.transformer.StackedBlocks`, whose leaves are
+    ``(n_stages, per_stage, ...)``): the leading stage dim is sharded so each
+    pipe shard holds only its own stage's parameters — the GPipe memory
+    contract.  Full-length specs so ``specs_like`` carries them onto the
+    optimizer state.
+    """
+    def rule(path: tuple[str, ...], leaf) -> P:
+        if marker in path and getattr(leaf, "ndim", 0) >= 1:
+            return P(axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return rule

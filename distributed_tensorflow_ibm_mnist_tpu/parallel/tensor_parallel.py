@@ -134,6 +134,25 @@ def megatron_rule(n_shards: int, axis: str = "model") -> SpecRule:
     return rule
 
 
+def chain_rules(*rules: SpecRule) -> SpecRule:
+    """Compose spec rules: the first non-replicated answer wins.
+
+    Order matters — structural rules (pipeline stage stacking, MoE expert
+    dims) must precede the Megatron name rules, whose suffix matches
+    (``dense_0`` etc.) would otherwise mis-shard the extra leading dims of
+    stacked leaves.
+    """
+
+    def rule(path: tuple[str, ...], leaf) -> P:
+        for r in rules:
+            spec = r(path, leaf)
+            if spec != P():
+                return spec
+        return P()
+
+    return rule
+
+
 def make_param_specs(params, rule: SpecRule):
     """Apply a spec rule over the param tree -> congruent PartitionSpec tree."""
     return jax.tree_util.tree_map_with_path(

@@ -61,6 +61,13 @@ class RunConfig:
     #                        composes with attn='flash' as the inner kernel)
     causal: bool = False  # causal attention mask, plumbed through whichever
     #                       attn path is active (sp island or single-device)
+    pp: int = 1  # pipeline-parallel degree over the 'pipe' mesh axis (GPipe
+    #              scan+ppermute over the ViT block stack; model must accept
+    #              pipeline_fn/pp_stages and depth % pp == 0; composes with dp)
+    pp_microbatches: int = 0  # microbatches streamed through the pipeline per
+    #                           step; 0 = pp (one in flight per stage).  More
+    #                           microbatches shrink the bubble: pp/(m+pp-1)
+    #                           of ticks are idle per stage.
     fsdp: bool = False  # ZeRO-3: shard params + opt state over 'data' (needs
     #                     dp>1; composes with tp into the 2D TP-within layout)
     # run control

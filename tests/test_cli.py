@@ -61,8 +61,8 @@ def test_build_config_parallelism_overrides():
     from distributed_tensorflow_ibm_mnist_tpu.launch.cli import build_config
 
     cfg = build_config(["--preset", "mnist_mlp_smoke", "--set", "dp=2",
-                        "--set", "tp=2", "--set", "sp=2"])
-    assert (cfg.dp, cfg.tp, cfg.sp) == (2, 2, 2)
+                        "--set", "tp=2", "--set", "sp=2", "--set", "pp=2"])
+    assert (cfg.dp, cfg.tp, cfg.sp, cfg.pp) == (2, 2, 2, 2)
 
 
 def test_build_config_round2_surface():

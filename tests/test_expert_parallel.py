@@ -6,6 +6,7 @@ token drops), forward and gradients, and the flax block must train.
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import (
     MoEBlock,
@@ -118,3 +119,46 @@ def test_moe_block_trains():
         loss, params = step(params)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_config_driven_expert_parallelism(eight_devices):
+    """MoE + dp>1 wires make_moe_dispatch automatically (VERDICT.md round-1
+    item 2): expert-stacked leaves (and their adam moments) sharded over
+    'data', training and eval finite end to end."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="moe_ep", model="vit",
+        model_kwargs={"patch_size": 7, "dim": 16, "depth": 2, "heads": 2,
+                      "moe_every": 1, "n_experts": 8, "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=64, epochs=1, lr=1e-3, dp=8, quiet=True, seed=12,
+        eval_batch_size=64,
+    )
+    t = Trainer(cfg)
+    assert t._moe_ep and t._gspmd
+    for blk in ("block_0", "block_1"):
+        moe = t.state.params[blk]["moe"]
+        assert moe["w1"].sharding.spec == P("data", None, None)
+        assert moe["router"].sharding.spec == P()
+    s = t.fit()
+    assert np.isfinite(s["best_test_accuracy"])
+    mu = t.state.opt_state[0].mu["block_0"]["moe"]["w1"]
+    assert mu.sharding.spec == P("data", None, None)
+
+
+def test_moe_ep_rejects_indivisible_experts(eight_devices):
+    import pytest
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    with pytest.raises(ValueError, match="divisible"):
+        Trainer(RunConfig(
+            model="vit", model_kwargs={"moe_every": 1, "n_experts": 6},
+            dataset="mnist", synthetic=True, n_train=64, n_test=32,
+            batch_size=32, dp=8, quiet=True,
+        ))

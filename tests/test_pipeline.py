@@ -101,3 +101,82 @@ def test_pipeline_trains(eight_devices):
         loss, stacked = step(stacked)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_config_driven_pp_trains_and_matches(eight_devices):
+    """RunConfig(pp=2) pipelines the ViT block stack (VERDICT.md round-1
+    item 2): stacked params sharded over 'pipe', and the dp=2 x pp=2
+    trajectory equals the same stacked model trained single-device."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="vit",
+        model_kwargs={"patch_size": 7, "dim": 16, "depth": 2, "heads": 2,
+                      "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=32, epochs=1, lr=1e-3, quiet=True, seed=11, eval_batch_size=32,
+    )
+    t_pp = Trainer(RunConfig(
+        name="pp", dp=2, pp=2, **{**base, "model_kwargs": dict(base["model_kwargs"])}
+    ))
+    stacked = t_pp.state.params["pipe_blocks"]["stacked"]
+    for leaf in jax.tree.leaves(stacked):
+        assert leaf.sharding.spec[0] == "pipe"
+        assert leaf.shape[0] == 2  # one slice per stage
+    s = t_pp.fit()
+    assert np.isfinite(s["best_test_accuracy"])
+    mu = t_pp.state.opt_state[0].mu["pipe_blocks"]["stacked"]
+    for leaf in jax.tree.leaves(mu):
+        assert leaf.sharding.spec[0] == "pipe"  # ZeRO-style: opt state follows
+
+    mk1 = dict(base["model_kwargs"])
+    mk1["pp_stages"] = 2  # same stacked init, local scan instead of the island
+    t_1 = Trainer(RunConfig(name="one", dp=1, **{**base, "model_kwargs": mk1}))
+    t_1.fit()
+    a, b = jax.device_get((t_pp.state.params, t_1.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
+
+
+def test_config_driven_pp_microbatches(eight_devices):
+    """pp_microbatches shrinks the bubble without changing the math."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="vit",
+        model_kwargs={"patch_size": 7, "dim": 16, "depth": 4, "heads": 2,
+                      "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=128, n_test=32,
+        batch_size=32, epochs=1, lr=1e-3, dp=1, pp=4, quiet=True, seed=13,
+        eval_batch_size=32,
+    )
+    t2 = Trainer(RunConfig(name="m2", pp_microbatches=2, **base))
+    t2.fit()
+    t8 = Trainer(RunConfig(name="m8", pp_microbatches=8, **base))
+    t8.fit()
+    a, b = jax.device_get((t2.state.params, t8.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
+
+
+def test_pp_rejects_bad_compositions(eight_devices):
+    import pytest
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    kw = dict(dataset="mnist", synthetic=True, n_train=64, n_test=32,
+              batch_size=32, quiet=True)
+    with pytest.raises(ValueError, match="pipeline"):
+        Trainer(RunConfig(model="lenet5", pp=2, **kw))  # no block stack
+    with pytest.raises(ValueError, match="sp"):
+        Trainer(RunConfig(model="vit", pp=2, sp=2, **kw))
+    with pytest.raises(ValueError, match="divide"):
+        Trainer(RunConfig(model="vit", pp=2, dp=2, batch_size=30,
+                          **{k: v for k, v in kw.items() if k != "batch_size"}))
