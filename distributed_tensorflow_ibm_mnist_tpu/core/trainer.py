@@ -416,7 +416,10 @@ class Trainer:
         """Resume from the checkpoint dir; returns the restored step."""
         if self._ckpt is None:
             raise ValueError("no checkpoint_dir configured")
-        restored = self._ckpt.restore(jax.device_get(self.state), step=step)
+        # the live state is the restore target: its shardings steer orbax to
+        # load each leaf directly into this run's layout (no host staging);
+        # _place_state is then a no-op re-assert of the placement contract
+        restored = self._ckpt.restore(self.state, step=step)
         self.state = self._place_state(restored)
         return int(jax.device_get(self.state.step))
 

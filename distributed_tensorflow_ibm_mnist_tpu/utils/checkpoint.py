@@ -30,19 +30,24 @@ class CheckpointManager:
         )
 
     def save(self, state: TrainState, wait: bool = False) -> int:
-        """Save at the state's current step; returns the step number."""
-        step = int(state.step)
-        # device_get so the saved tree is host numpy regardless of sharding.
-        host_state = jax.device_get(state)
-        # Serialize with any in-flight async save: a same-step re-save (e.g.
-        # checkpoint_every landing on the final epoch) must not delete the
-        # directory a background write is still filling.
-        self._mgr.wait_until_finished()
-        # Orbax refuses (or silently skips) a step that already exists, which
-        # would drop the weights of a rerun landing on the same step — replace.
+        """Save at the state's current step; returns the step number.
+
+        The state's ``jax.Array`` leaves go to orbax AS PLACED — sharded
+        leaves are written shard-by-shard from their owning hosts, never
+        gathered (VERDICT.md round-1 item 4: the old ``jax.device_get``
+        defeated FSDP's memory bound at every checkpoint).  Orbax copies
+        device data out before returning, so the caller may donate the
+        buffers immediately; the disk write proceeds in the background.
+        """
+        step = int(jax.device_get(state.step))
         if step in self._mgr.all_steps():
+            # Same-step overwrite (e.g. checkpoint_every landing on the final
+            # epoch): this is the ONE case that must serialize with an
+            # in-flight async save — deleting a directory a background write
+            # is still filling corrupts it.  Distinct steps stay fully async.
+            self._mgr.wait_until_finished()
             self._mgr.delete(step)
-        self._mgr.save(step, args=ocp.args.StandardSave(host_state), force=True)
+        self._mgr.save(step, args=ocp.args.StandardSave(state), force=True)
         if wait:
             self._mgr.wait_until_finished()
         return step
@@ -51,20 +56,28 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def restore(self, target: TrainState, step: int | None = None) -> TrainState:
-        """Restore into the structure of ``target`` (a freshly-created state).
+        """Restore into the structure (and placement) of ``target``.
 
-        The caller re-places the result on devices (replicate/shard) —
-        restore itself is layout-agnostic, which is what makes resume work
-        across different process counts (SURVEY.md §5 requirement).
+        When ``target`` leaves are placed ``jax.Array``s, their shardings go
+        into the abstract tree and orbax restores each leaf DIRECTLY into
+        that layout — resharding from whatever layout saved it, loading only
+        this host's shards.  Host-numpy targets restore to host as before.
+        Either way resume works across process/device layouts (SURVEY.md §5
+        requirement): the checkpoint on disk is layout-agnostic.
         """
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self._dir}")
-        abstract = jax.tree.map(
-            lambda x: ocp.utils.to_shape_dtype_struct(x) if hasattr(x, "shape") else x,
-            jax.device_get(target),
-        )
+
+        def to_abstract(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if hasattr(x, "shape"):
+                return ocp.utils.to_shape_dtype_struct(x)
+            return x
+
+        abstract = jax.tree.map(to_abstract, target)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
     def wait(self) -> None:

