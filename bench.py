@@ -3,10 +3,17 @@
 Measures the two BASELINE.json:2 metrics of record on the reference's own
 headline task (the MNIST CNN of SURVEY.md §2.1):
 
-* images/sec/chip — steady-state training throughput (primary metric);
+* images/sec/chip — steady-state training throughput (primary metric),
+  via the supported ``Trainer.measure_throughput`` API (chained epoch
+  dispatches, one readback — per-epoch readbacks would measure the
+  host<->device link, not the chip);
 * wall-clock to 99% test accuracy — reported both including and excluding
-  the one-time XLA compile (the reference's TF1 session had no compile stage;
-  its per-step feed_dict overhead is precisely what this design removes).
+  the one-time XLA compile (the reference's TF1 session had no compile
+  stage; its per-step feed_dict overhead is precisely what this design
+  removes);
+
+plus MFU (fraction of the chip's bf16 peak, from XLA's cost analysis of the
+compiled epoch — see docs/PERFORMANCE.md for the denominator).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -21,7 +28,6 @@ host->device feed + PS variable RPCs bound it; SURVEY.md §3.1).
 from __future__ import annotations
 
 import json
-import math
 import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 10_000.0  # nominal reference estimate, see docstring
@@ -30,7 +36,6 @@ TARGET_ACC = 0.99
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
     from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
     from distributed_tensorflow_ibm_mnist_tpu.utils.config import get_preset
@@ -45,69 +50,47 @@ def main() -> None:
     )
     trainer = Trainer(cfg)
 
-    # Warm the compile caches (epoch runner + eval) outside the timed region:
-    # shapes must match, so run one real epoch and reset.  Snapshot the fresh
-    # state to host first: the epoch runner donates its input buffers, so the
-    # device copy dies in the warmup call.
-    state0_host = jax.device_get(trainer.state)
-    t_compile0 = time.perf_counter()
-    warm_state, _ = trainer._run_epoch(
-        trainer.state, trainer.train_images, trainer.train_labels, jax.random.PRNGKey(123)
-    )
-    jax.device_get(
-        trainer._eval(warm_state, trainer.test_images, trainer.test_labels)["accuracy"]
-    )
-    compile_and_first_epoch_s = time.perf_counter() - t_compile0
+    # Phase 1 — steady-state throughput + MFU (public API; also warms the
+    # epoch-runner compile cache and restores the fresh state afterwards).
+    tput = trainer.measure_throughput(epochs=10)
 
-    # Phase 1 — steady-state throughput: K chained epochs dispatched
-    # back-to-back with ONE readback at the end, so the pipeline never stalls
-    # on host<->device latency.  This is the honest device rate: per-epoch
-    # blocking readbacks measure the interconnect, not the chip.
-    K = 10
-    state = warm_state
-    t1 = time.perf_counter()
-    for i in range(K):
-        state, metrics = trainer._run_epoch(
-            state, trainer.train_images, trainer.train_labels, jax.random.fold_in(jax.random.PRNGKey(7), i)
-        )
-    last_loss = float(jax.device_get(metrics["loss"])[-1])
-    throughput_wall = time.perf_counter() - t1
-    chips = trainer.dp if trainer.dp > 1 else 1
-    images_per_sec = trainer.steps_per_epoch * cfg.batch_size * K / throughput_wall / chips
-    if not math.isfinite(last_loss):
-        raise RuntimeError(f"non-finite loss in throughput phase: {last_loss}")
+    # Warm the eval compile outside phase 2's timed region (same shapes).
+    trainer.evaluate()
 
-    # Phase 2 — wall-clock to 99% test accuracy, from a fresh state with warm
-    # caches (eval every epoch; early-stops at target).
-    trainer.state = jax.tree.map(jnp.asarray, state0_host)
+    # Phase 2 — wall-clock to 99% test accuracy from the fresh state with
+    # warm caches (eval every epoch; early-stops at target).
     t0 = time.perf_counter()
     summary = trainer.fit()
     wall_excl_compile = time.perf_counter() - t0
 
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
-        "value": round(images_per_sec, 1),
+        "value": tput["images_per_sec_per_chip"],
         "unit": "images/sec/chip",
-        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "vs_baseline": round(
+            tput["images_per_sec_per_chip"] / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
+        ),
+        "mfu": tput["mfu"],
+        "model_tflops_per_sec_per_chip": tput["model_tflops_per_sec_per_chip"],
         "best_test_accuracy": summary["best_test_accuracy"],
         "target_accuracy": TARGET_ACC,
         "time_to_target_s_excl_compile": (
             round(wall_excl_compile, 3) if summary["time_to_target_s"] else None
         ),
         "time_to_target_s_incl_compile": (
-            round(wall_excl_compile + compile_and_first_epoch_s, 3)
+            round(wall_excl_compile + tput["compile_and_first_epoch_s"], 3)
             if summary["time_to_target_s"]
             else None
         ),
         "north_star_target_s": 60.0,
         "epochs_run": summary["epochs_run"],
-        "throughput_epochs": K,
+        "throughput_epochs": tput["epochs"],
         # measurement condition (deviates from the BASELINE.json:8 preset's
         # batch=128 on purpose — the metric of record is images/sec/chip and
         # time-to-99%, and batch is a free knob of the rebuild, not the task):
         "batch_size": cfg.batch_size,
         "lr": cfg.lr,
-        "device": str(jax.devices()[0]),
+        "device": tput["device"],
         "param_count": summary["param_count"],
     }
     print(json.dumps(result), flush=True)

@@ -100,6 +100,7 @@ def replicate(mesh: Mesh, tree):
 def make_dp_train_step(
     model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0,
     fused_xent: bool = False, remat: bool = False, grad_accum: int = 1,
+    img_ndim: int = 4,
 ):
     """Single DP step over a batch sharded along the data axis.
 
@@ -112,7 +113,7 @@ def make_dp_train_step(
         model, tx, axis_name=axis, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
-    img_spec = P(axis, *([None] * 3))
+    img_spec = P(axis, *([None] * (img_ndim - 1)))
     wrapped = shard_map_compat(
         train_step,
         mesh,
@@ -125,15 +126,19 @@ def make_dp_train_step(
 def make_dp_chunk_runner(
     model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0,
     fused_xent: bool = False, remat: bool = False, grad_accum: int = 1,
+    img_ndim: int = 4,
 ):
     """DP companion of steps.make_chunk_runner: scan k stacked global batches
     (leaves ``(k, global_batch, ...)``, batch dim sharded over ``axis``) in one
-    compiled shard_map call — stream mode's one-transfer-per-k-steps path."""
+    compiled shard_map call — stream mode's one-transfer-per-k-steps path.
+
+    ``img_ndim``: rank of ONE image batch (4 for NHWC); callers with other
+    input ranks pass their own so the spec's trailing dims match."""
     run_chunk = make_chunk_runner(
         model, tx, axis_name=axis, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
-    img_spec = P(None, axis, *([None] * 3))
+    img_spec = P(None, axis, *([None] * (img_ndim - 1)))
     wrapped = shard_map_compat(
         run_chunk,
         mesh,
@@ -153,6 +158,7 @@ def make_dp_epoch_runner(
     fused_xent: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    img_ndim: int = 4,
 ):
     """Epoch runner over a sharded dataset: one jitted shard_map per epoch.
 
@@ -174,7 +180,7 @@ def make_dp_epoch_runner(
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
 
-    img_spec = P(axis, *([None] * 3))
+    img_spec = P(axis, *([None] * (img_ndim - 1)))
     wrapped = shard_map_compat(
         local_epoch,
         mesh,

@@ -1,0 +1,91 @@
+"""FLOPs accounting + MFU (model FLOPs utilization) reporting.
+
+The reference had no FLOPs accounting at all (SURVEY.md §5 metrics row:
+wall-clock prints only); MFU is the rebuild's chip-efficiency metric of
+record next to images/sec/chip (VERDICT.md round-1 item 5).
+
+FLOPs come from XLA's own cost analysis of the COMPILED program — the
+honest count: it includes rematerialized forward passes under ``remat``,
+excludes ops the compiler folded away, and under SPMD shardings reports the
+per-device program's FLOPs (verified: an 8-way-sharded matmul reports 1/8
+the single-device count), which is exactly the numerator MFU needs.
+
+MFU denominator: the chip's peak matmul throughput at the dtype the model
+computes in (bf16 for the zoo's default).  Peaks are keyed on
+``device_kind`` from public TPU specs; ``$DTM_PEAK_TFLOPS`` overrides for
+kinds not in the table (and is the only option on CPU, where "peak" is
+ill-defined and MFU is reported as None).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+# bf16 dense peak TFLOP/s per chip, public spec-sheet numbers.
+_PEAK_TFLOPS_BF16: dict[str, float] = {
+    "TPU v2": 22.5,
+    "TPU v3": 61.5,  # a.k.a. 123 per dual-core board
+    "TPU v4": 137.5,  # 275 per 2-die chip; device_kind is per chip -> 275
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 229.5,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+    "TPU v7": 2307.0,
+}
+
+
+def device_peak_tflops(device=None) -> float | None:
+    """Peak bf16 TFLOP/s for ``device`` (default: first visible device).
+
+    Longest-prefix match on ``device_kind`` so variants like
+    "TPU v5 lite podslice" resolve; ``$DTM_PEAK_TFLOPS`` wins outright.
+    Returns None when unknown (CPU, exotic kinds) — callers report MFU as
+    None rather than against a made-up peak.
+    """
+    env = os.environ.get("DTM_PEAK_TFLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    device = device or jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "")).strip()
+    if kind == "TPU v4":
+        return 275.0  # device_kind names the 2-die chip, not the die
+    best = None
+    for prefix, peak in _PEAK_TFLOPS_BF16.items():
+        if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
+            best = (len(prefix), peak)
+    return best[1] if best else None
+
+
+def compiled_flops(jitted_fn, *args) -> float | None:
+    """Per-device FLOPs of one call of a jitted function, from XLA's cost
+    analysis of the compiled (post-SPMD-partitioning) module.
+
+    ``lower()`` re-traces but ``compile()`` hits the executable cache, so
+    calling this on an already-hot function costs tracing time only.  None
+    when the backend doesn't expose cost analysis.
+    """
+    try:
+        cost = jitted_fn.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_sec_per_chip: float | None, device=None) -> float | None:
+    """flops/sec/chip -> fraction of the chip's bf16 peak (None off-table)."""
+    if not flops_per_sec_per_chip:
+        return None
+    peak = device_peak_tflops(device)
+    if not peak:
+        return None
+    return flops_per_sec_per_chip / (peak * 1e12)

@@ -1,0 +1,86 @@
+"""FLOPs/MFU accounting + the public throughput-measurement API."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+    compiled_flops,
+    device_peak_tflops,
+    mfu,
+)
+
+
+def test_compiled_flops_matmul():
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((256, 256))
+    flops = compiled_flops(f, a, a)
+    # 2 * n^3 MACs-as-flops for a square matmul
+    assert flops == 2 * 256**3
+
+
+def test_peak_tflops_env_override(monkeypatch):
+    monkeypatch.setenv("DTM_PEAK_TFLOPS", "123.5")
+    assert device_peak_tflops() == 123.5
+
+
+def test_peak_tflops_unknown_cpu(monkeypatch):
+    monkeypatch.delenv("DTM_PEAK_TFLOPS", raising=False)
+    # CPU device_kind is not a TPU -> None, and mfu degrades to None
+    assert device_peak_tflops() is None
+    assert mfu(1e12) is None
+
+
+def test_mfu_fraction(monkeypatch):
+    monkeypatch.setenv("DTM_PEAK_TFLOPS", "100")
+    assert abs(mfu(50e12) - 0.5) < 1e-9
+
+
+def test_measure_throughput_public_api(monkeypatch):
+    """Supported benchmark path: sane numbers, MFU populated when a peak is
+    known, and the trainer's state restored untouched."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    monkeypatch.setenv("DTM_PEAK_TFLOPS", "100")
+    t = Trainer(RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, dataset="mnist",
+        synthetic=True, n_train=256, n_test=64, batch_size=64, epochs=1,
+        quiet=True, eval_batch_size=64,
+    ))
+    before = jax.device_get(t.state.params)
+    out = t.measure_throughput(epochs=2)
+    after = jax.device_get(t.state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert out["images_per_sec"] > 0
+    assert out["images_per_sec_per_chip"] == out["images_per_sec"]  # 1 chip
+    assert out["epochs"] == 2 and out["chips"] == 1
+    assert np.isfinite(out["last_loss"])
+    assert out["model_tflops_per_sec_per_chip"] > 0
+    assert 0 < out["mfu"] < 1
+
+
+def test_fit_summary_reports_mfu(monkeypatch):
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    monkeypatch.setenv("DTM_PEAK_TFLOPS", "100")
+    t = Trainer(RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, dataset="mnist",
+        synthetic=True, n_train=256, n_test=64, batch_size=64, epochs=2,
+        quiet=True, eval_batch_size=64,
+    ))
+    s = t.fit()
+    assert s["model_tflops_per_sec_per_chip"] > 0
+    assert s["mfu"] is not None
+
+
+def test_bench_uses_no_private_internals():
+    """bench.py must drive the public API only (VERDICT.md round-1 item 9)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "bench.py")) as f:
+        src = f.read()
+    assert "trainer._" not in src and "._run_epoch" not in src and "._eval" not in src
