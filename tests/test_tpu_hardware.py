@@ -101,6 +101,87 @@ print("GOLDEN_OK", s["best_test_accuracy"], s["images_per_sec_per_chip"], flush=
 '''
 
 
+GSPMD = r'''
+import jax, jax.numpy as jnp, numpy as np, optax
+assert jax.devices()[0].platform == "tpu", jax.devices()
+
+from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import make_ring_attention
+from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+    make_param_specs, make_tp_train_step, megatron_rule, shard_train_state,
+)
+
+# The GSPMD path (jit with NamedShardings + shard_map islands) at tp=sp=1 on
+# ONE chip: same program structure multi-chip runs compile, minus the ICI.
+mesh = make_mesh(dp=1, tp=1, sp=1)
+vit = get_model("vit", num_classes=10, patch_size=7, dim=64, depth=2, heads=4,
+                attn_fn=make_ring_attention(mesh))
+tx = optax.adam(1e-3)
+state = TrainState.create(vit, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1), jnp.uint8))
+specs = make_param_specs(state.params, megatron_rule(1))
+step = make_tp_train_step(vit, tx, mesh, specs, state)
+state = shard_train_state(mesh, state, specs)
+rng = np.random.default_rng(0)
+batch = {
+    "image": jnp.asarray(rng.integers(0, 255, (64, 28, 28, 1), dtype=np.uint8)),
+    "label": jnp.asarray(rng.integers(0, 10, 64).astype(np.int32)),
+}
+for _ in range(2):
+    state, metrics = step(state, batch)
+loss = float(jax.device_get(metrics["loss"]))
+assert np.isfinite(loss), loss
+
+# GPipe island on a 1-stage pipe ring: scan + ppermute + broadcast on-chip.
+from distributed_tensorflow_ibm_mnist_tpu.parallel.pipeline import (
+    make_pipeline_apply, stack_stage_params,
+)
+mesh_pp = make_mesh(dp=1, pp=1)
+w = jnp.asarray(rng.normal(0, 0.3, (32, 32)).astype(np.float32))
+pp_apply = jax.jit(make_pipeline_apply(
+    lambda p, x: jnp.tanh(x @ p["w"]) + x, mesh_pp, n_microbatches=2,
+    batch_axis="data",
+))
+y = pp_apply(stack_stage_params([{"w": w}]), jnp.ones((8, 32), jnp.float32))
+assert np.all(np.isfinite(jax.device_get(y)))
+
+# MoE all_to_all island on a size-1 axis.
+from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import make_moe_dispatch
+moe = jax.jit(make_moe_dispatch(mesh_pp, n_experts=4, capacity=8))
+params = {
+    "router": jnp.asarray(rng.normal(0, 0.3, (32, 4)).astype(np.float32)),
+    "w1": jnp.asarray(rng.normal(0, 0.3, (4, 32, 64)).astype(np.float32)),
+    "b1": jnp.zeros((4, 64), jnp.float32),
+    "w2": jnp.asarray(rng.normal(0, 0.3, (4, 64, 32)).astype(np.float32)),
+    "b2": jnp.zeros((4, 32), jnp.float32),
+}
+out, aux = moe(params, jnp.asarray(rng.normal(0, 1, (16, 32)).astype(np.float32)))
+assert np.all(np.isfinite(jax.device_get(out))) and np.isfinite(float(aux))
+print("GSPMD_TPU_OK", loss, flush=True)
+'''
+
+
+@pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
+def test_gspmd_path_on_real_tpu():
+    """VERDICT.md round-1 item 10: the GSPMD machinery every multi-chip run
+    depends on (jit with NamedShardings, Megatron spec placement, ring/
+    pipeline/MoE shard_map islands) compiles and executes on the real chip,
+    so Mosaic/GSPMD-specific breakage can't hide behind the CPU mesh."""
+    probe = subprocess.run(
+        [sys.executable, "-c", PROBE], capture_output=True, text=True,
+        timeout=120, cwd=str(REPO), env=_default_env(),
+    )
+    if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
+        pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
+    proc = subprocess.run(
+        [sys.executable, "-c", GSPMD], capture_output=True, text=True,
+        timeout=560, cwd=str(REPO), env=_default_env(),
+    )
+    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
+    assert "GSPMD_TPU_OK" in proc.stdout
+
+
 @pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
 def test_lenet_golden_metric_on_tpu():
     """SURVEY.md §4 golden-metric job: the [B:8] LeNet config on the real
