@@ -1,0 +1,66 @@
+"""Measure the BASELINE.md configs on the attached hardware.
+
+Runs a named preset in its single-chip form (dp forced to 1 — multi-chip
+hardware isn't attached in this environment; the dp>1 layouts are validated
+on the virtual mesh and by the driver's dryrun) and prints ONE JSON line
+with the BASELINE.json:2 metrics of record: steady-state images/sec/chip
+(+ MFU) via ``Trainer.measure_throughput`` and wall-clock-to-target via
+``Trainer.fit``.
+
+Usage:
+    python scripts/measure_baselines.py <preset> [throughput_epochs]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# runnable from anywhere: the package lives at the repo root, one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    preset = sys.argv[1]
+    tput_epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    import jax
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import get_preset
+
+    cfg = get_preset(preset)
+    name = cfg.name + ("_1chip" if cfg.dp > 1 else "")
+    cfg = cfg.replace(name=name, dp=1, quiet=True)
+    trainer = Trainer(cfg)
+
+    tput = trainer.measure_throughput(epochs=tput_epochs)
+    trainer.evaluate()  # warm the eval compile outside the timed fit
+    t0 = time.perf_counter()
+    summary = trainer.fit()
+    fit_wall = time.perf_counter() - t0
+
+    print(json.dumps({
+        "preset": preset,
+        "name": name,
+        "dataset": cfg.dataset,
+        "synthetic_data": trainer.config.synthetic is not False,
+        "batch_size": cfg.batch_size,
+        "images_per_sec_per_chip": tput["images_per_sec_per_chip"],
+        "mfu": tput["mfu"],
+        "model_tflops_per_sec_per_chip": tput["model_tflops_per_sec_per_chip"],
+        "compile_and_first_epoch_s": tput["compile_and_first_epoch_s"],
+        "best_test_accuracy": summary["best_test_accuracy"],
+        "target_accuracy": cfg.target_accuracy,
+        "time_to_target_s": summary["time_to_target_s"],
+        "fit_wall_s_excl_compile": round(fit_wall, 3),
+        "epochs_run": summary["epochs_run"],
+        "param_count": summary["param_count"],
+        "device": tput["device"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
