@@ -107,6 +107,8 @@ class Trainer:
             seed=config.seed, synthetic=config.synthetic,
         )
         self.num_classes = data["num_classes"]
+        # which source synthetic=None actually resolved to (provenance)
+        self.data_synthetic: bool = bool(data.get("synthetic", True))
 
         self.tp = max(1, config.tp)
         self.sp = max(1, config.sp)
@@ -227,8 +229,9 @@ class Trainer:
             m = config.pp_microbatches or self.pp
             if config.batch_size % (self.dp * m):
                 raise ValueError(
-                    f"batch_size {config.batch_size} must divide dp*microbatches "
-                    f"({self.dp}x{m}) so training always uses the pipeline island"
+                    f"batch_size {config.batch_size} must be a multiple of "
+                    f"dp*microbatches ({self.dp}x{m}={self.dp * m}) so training "
+                    f"always uses the pipeline island"
                 )
         step_kw = dict(
             label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
@@ -534,46 +537,51 @@ class Trainer:
         cfg = self.config
         state0 = jax.device_get(self.state)  # epoch runner donates its input
         rng = jax.random.PRNGKey(123)
-        t0 = time.perf_counter()
-        state, m = self._run_epoch(
-            self.state, self.train_images, self.train_labels, rng
-        )
-        jax.device_get(m["loss"])  # readback = the reliable execution fence
-        compile_and_first_epoch_s = time.perf_counter() - t0
-
-        t1 = time.perf_counter()
-        for i in range(epochs):
+        try:
+            t0 = time.perf_counter()
             state, m = self._run_epoch(
-                state, self.train_images, self.train_labels, jax.random.fold_in(rng, i)
+                self.state, self.train_images, self.train_labels, rng
             )
-        last_loss = float(np.mean(jax.device_get(m["loss"])))
-        wall = time.perf_counter() - t1
-        if not math.isfinite(last_loss):
-            raise RuntimeError(f"non-finite loss during throughput measurement: {last_loss}")
+            jax.device_get(m["loss"])  # readback = the reliable execution fence
+            compile_and_first_epoch_s = time.perf_counter() - t0
 
-        images = self.steps_per_epoch * cfg.batch_size * epochs
-        ips_chip = images / wall / self.n_chips
-        flops_epoch = self._epoch_flops()
-        from distributed_tensorflow_ibm_mnist_tpu.utils.flops import mfu as _mfu
+            t1 = time.perf_counter()
+            for i in range(epochs):
+                state, m = self._run_epoch(
+                    state, self.train_images, self.train_labels, jax.random.fold_in(rng, i)
+                )
+            last_loss = float(np.mean(jax.device_get(m["loss"])))
+            wall = time.perf_counter() - t1
+            if not math.isfinite(last_loss):
+                raise RuntimeError(
+                    f"non-finite loss during throughput measurement: {last_loss}"
+                )
 
-        fps_chip = flops_epoch * epochs / wall if flops_epoch else None
-        result = {
-            "images_per_sec": round(images / wall, 1),
-            "images_per_sec_per_chip": round(ips_chip, 1),
-            "epochs": epochs,
-            "steps_per_epoch": self.steps_per_epoch,
-            "batch_size": cfg.batch_size,
-            "chips": self.n_chips,
-            "compile_and_first_epoch_s": round(compile_and_first_epoch_s, 3),
-            "model_tflops_per_sec_per_chip": (
-                round(fps_chip / 1e12, 6) if fps_chip else None
-            ),
-            "mfu": (lambda v: round(v, 6) if v is not None else None)(_mfu(fps_chip)),
-            "last_loss": last_loss,
-            "device": str(jax.devices()[0]),
-        }
-        self.state = self._place_state(state0)
-        return result
+            images = self.steps_per_epoch * cfg.batch_size * epochs
+            ips_chip = images / wall / self.n_chips
+            flops_epoch = self._epoch_flops()
+            from distributed_tensorflow_ibm_mnist_tpu.utils.flops import mfu as _mfu
+
+            fps_chip = flops_epoch * epochs / wall if flops_epoch else None
+            return {
+                "images_per_sec": round(images / wall, 1),
+                "images_per_sec_per_chip": round(ips_chip, 1),
+                "epochs": epochs,
+                "steps_per_epoch": self.steps_per_epoch,
+                "batch_size": cfg.batch_size,
+                "chips": self.n_chips,
+                "compile_and_first_epoch_s": round(compile_and_first_epoch_s, 3),
+                "model_tflops_per_sec_per_chip": (
+                    round(fps_chip / 1e12, 6) if fps_chip else None
+                ),
+                "mfu": (lambda v: round(v, 6) if v is not None else None)(_mfu(fps_chip)),
+                "last_loss": last_loss,
+                "device": str(jax.devices()[0]),
+            }
+        finally:
+            # the warm call donated self.state's buffers — restore even on
+            # error so the trainer honors "training is undisturbed"
+            self.state = self._place_state(state0)
 
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))

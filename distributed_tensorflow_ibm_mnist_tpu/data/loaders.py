@@ -164,7 +164,9 @@ def load_dataset(
             kwargs["n_train"] = n_train
         if n_test is not None:
             kwargs["n_test"] = n_test
-        return gen(**kwargs)
+        out = gen(**kwargs)
+        out["synthetic"] = True  # measurement provenance (synthetic=None resolves here)
+        return out
 
     if n_train is not None:
         real["train_images"] = real["train_images"][:n_train]
@@ -172,4 +174,5 @@ def load_dataset(
     if n_test is not None:
         real["test_images"] = real["test_images"][:n_test]
         real["test_labels"] = real["test_labels"][:n_test]
+    real["synthetic"] = False
     return real

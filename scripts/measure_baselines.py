@@ -46,7 +46,7 @@ def main() -> None:
         "preset": preset,
         "name": name,
         "dataset": cfg.dataset,
-        "synthetic_data": trainer.config.synthetic is not False,
+        "synthetic_data": trainer.data_synthetic,  # as RESOLVED by the loader
         "batch_size": cfg.batch_size,
         "images_per_sec_per_chip": tput["images_per_sec_per_chip"],
         "mfu": tput["mfu"],

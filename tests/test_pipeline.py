@@ -177,6 +177,6 @@ def test_pp_rejects_bad_compositions(eight_devices):
         Trainer(RunConfig(model="lenet5", pp=2, **kw))  # no block stack
     with pytest.raises(ValueError, match="sp"):
         Trainer(RunConfig(model="vit", pp=2, sp=2, **kw))
-    with pytest.raises(ValueError, match="divide"):
+    with pytest.raises(ValueError, match="multiple"):
         Trainer(RunConfig(model="vit", pp=2, dp=2, batch_size=30,
                           **{k: v for k, v in kw.items() if k != "batch_size"}))
