@@ -207,6 +207,13 @@ class Trainer:
                 self.mesh, n_exp,
                 capacity_factor=model_kwargs.get("moe_capacity_factor", 2.0),
             ))
+        if config.remat == "blocks":
+            if not model_accepts(config.model, "block_remat"):
+                raise ValueError(
+                    f"remat='blocks' needs a block-structured model "
+                    f"(resnet*/vit); got {config.model!r}"
+                )
+            model_kwargs.setdefault("block_remat", True)
         self.model = get_model(
             config.model, num_classes=self.num_classes, **model_kwargs
         )
@@ -235,7 +242,7 @@ class Trainer:
                 )
         step_kw = dict(
             label_smoothing=config.label_smoothing, fused_xent=config.fused_xent,
-            remat=config.remat, grad_accum=config.grad_accum,
+            remat=config.remat is True, grad_accum=config.grad_accum,
         )
         if self._stream:
             # host-resident dataset (HBM holds only the in-flight batches);

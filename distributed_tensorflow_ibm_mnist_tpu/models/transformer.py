@@ -179,6 +179,9 @@ class VisionTransformer(nn.Module):
     pp_stages: int = 0  # >0: stack blocks (n_stages, per_stage, ...) for the
     #                     GPipe island — params shardable over 'pipe'
     pipeline_fn: Callable | None = None  # (stage_fn, stacked_params, x) -> y
+    block_remat: bool = False  # jax.checkpoint each block (backward
+    #                            recomputes within-block activations; the
+    #                            O(depth) memory lever for deep/long-seq runs)
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -217,14 +220,21 @@ class VisionTransformer(nn.Module):
             x = x.mean(axis=1)
             x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
             return x.astype(jnp.float32)
+        # static_argnums: (self, x, train) -> train must stay a Python bool
+        # through the checkpoint (it selects dropout determinism)
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(2,))
+            if self.block_remat
+            else TransformerBlock
+        )
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = block_cls(
                 dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout, attn_fn=self.attn_fn, attn=self.attn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
                 moe_fn=self.moe_fn, dtype=self.dtype, name=f"block_{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = x.mean(axis=1)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

@@ -43,7 +43,12 @@ class RunConfig:
     label_smoothing: float = 0.0
     fused_xent: bool = False  # Pallas fused softmax-xent kernel (ops/xent.py) for the train loss
     grad_accum: int = 1  # microbatches per step (gradient accumulation)
-    remat: bool = False  # jax.checkpoint the forward: recompute activations in bwd
+    remat: bool | str = False  # False | True | "blocks".  True checkpoints the
+    #   WHOLE forward (saves scan residuals across steps only — peak memory
+    #   within a step is unchanged, measured on v5e).  "blocks" checkpoints
+    #   each residual/transformer block (models with block_remat), the real
+    #   per-step memory lever: batch-4096 ResNet-50 trains on one 16G chip
+    #   with "blocks" where both False and True OOM at 19.7G.
     # input pipeline
     input_mode: str = "device"  # device: dataset HBM-resident, scan epochs;
     #                             stream: host-resident, C++-prefetched per-step batches
