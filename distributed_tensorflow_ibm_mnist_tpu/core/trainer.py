@@ -513,15 +513,27 @@ class Trainer:
 
     def _epoch_flops(self) -> float | None:
         """Per-device FLOPs of one compiled epoch (XLA cost analysis of the
-        post-partitioning module; None in stream mode / off-table backends)."""
+        post-partitioning module; None in stream mode / off-table backends).
+
+        XLA's cost analysis counts a while-loop BODY once regardless of trip
+        count (verified on both the TPU and CPU backends with a scanned
+        matmul), so the reported figure is scaled by the epoch scan's step
+        count and the nested grad-accum scan's microbatch count.  Loops whose
+        bodies are not the FLOPs carrier (the epoch permutation, ring/pipeline
+        inner loops at their single-chip trip counts) make this exact for the
+        zoo's standard paths and a slight undercount under sp/pp islands.
+        """
         if self._stream:
             return None
         from distributed_tensorflow_ibm_mnist_tpu.utils.flops import compiled_flops
 
-        return compiled_flops(
+        per_call = compiled_flops(
             self._run_epoch, self.state, self.train_images, self.train_labels,
             jax.random.PRNGKey(0),
         )
+        if per_call is None:
+            return None
+        return per_call * self.steps_per_epoch * max(1, self.config.grad_accum)
 
     def measure_throughput(self, epochs: int = 10) -> dict[str, Any]:
         """Steady-state training throughput + MFU under the run's own layout

@@ -84,3 +84,37 @@ def test_bench_uses_no_private_internals():
     with open(os.path.join(root, "bench.py")) as f:
         src = f.read()
     assert "trainer._" not in src and "._run_epoch" not in src and "._eval" not in src
+
+
+def test_cost_analysis_counts_scan_body_once():
+    """Pins the XLA behavior _epoch_flops corrects for: a while-loop body's
+    FLOPs are reported ONCE regardless of trip count. If a jax/XLA upgrade
+    starts scaling by trip count, this fails and the steps_per_epoch
+    multiplier in Trainer._epoch_flops must be removed."""
+    from jax import lax
+
+    a = jnp.ones((128, 128))
+    one = jax.jit(lambda a: a @ a)
+    scan4 = jax.jit(lambda a: lax.scan(lambda c, _: (c @ a, None), a, None, length=4)[0])
+    # scan4 adds a couple of loop-counter flops; the matmul body must appear
+    # exactly once (4x would be ~12.6M)
+    assert abs(compiled_flops(scan4, a) - compiled_flops(one, a)) < 1000
+
+
+def test_epoch_flops_matches_analytic():
+    """Trainer._epoch_flops lands within sane bounds of the analytic matmul
+    count (fwd 2*MACs; train ~3x fwd), i.e. the scan-trip scaling is applied
+    exactly once."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    t = Trainer(RunConfig(
+        model="mlp", model_kwargs={"hidden": (256,), "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=1024, n_test=64,
+        batch_size=128, epochs=1, quiet=True, eval_batch_size=64,
+    ))
+    got = t._epoch_flops()
+    macs_per_img = 784 * 256 + 256 * 10
+    fwd_flops_epoch = 2 * macs_per_img * 128 * t.steps_per_epoch
+    # train step = fwd + bwd (~2x fwd) + optimizer noise: expect ~3x fwd
+    assert 2 * fwd_flops_epoch < got < 6 * fwd_flops_epoch, (got, fwd_flops_epoch)
