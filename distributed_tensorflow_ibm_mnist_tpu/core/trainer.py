@@ -401,7 +401,10 @@ class Trainer:
                 make_ring_attention,
             )
 
-            return make_ring_attention(self.mesh, causal=cfg.causal)
+            # attn='flash' upgrades the per-block computation to the Pallas
+            # kernel (O(S_local) memory; lse-merged across ring hops)
+            inner = "flash" if model_kwargs.get("attn") == "flash" else "dense"
+            return make_ring_attention(self.mesh, causal=cfg.causal, inner=inner)
         if cfg.sp_impl == "ulysses":
             from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
                 vanilla_attention,

@@ -245,19 +245,30 @@ def _flash_fwd(q, k, v, causal, interpret):
 
 
 def _flash_bwd(causal, interpret, res, g):
+    q, k, v, out, lse = res
+    gp, op, _, _ = _prepare(g, out, out)
+    # delta_i = rowsum(dO_i * O_i) — the flash-bwd correction term
+    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1, keepdims=True)
+    return _bwd_calls(q, k, v, g, lse, delta, causal, interpret)
+
+
+def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
+    """The two backward pallas calls from padded-layout lse/delta.
+
+    ``lse``/``delta`` are (B*H, S_pad, 1) f32 — the GLOBAL row statistics.
+    Factored out of :func:`_flash_bwd` so ring attention can drive the same
+    kernels per K/V block with the statistics of the full ring
+    (parallel/ring_attention.py)."""
     if interpret is None:
         interpret = not _on_tpu()
-    q, k, v, out, lse = res
     qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
-    gp, op, _, _ = _prepare(g, out, out)
+    gp = _prepare(g, g, g)[0]
     bh, sp, dp_ = qp.shape
     block_q = _pick_block(sp)
     block_k = _pick_block(sp)
     n_q = sp // block_q
     n_k = sp // block_k
     sm_scale = d**-0.5
-    # delta_i = rowsum(dO_i * O_i) — the flash-bwd correction term
-    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1, keepdims=True)
 
     dkv = pl.pallas_call(
         partial(_dkv_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
@@ -312,6 +323,50 @@ def _flash_bwd(causal, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _lse_to_bsh(lse_p, b, s, h):
+    """(B*H, S_pad, 1) f32 -> (B, S, H)."""
+    return lse_p[:, :s, 0].reshape(b, h, s).transpose(0, 2, 1)
+
+
+def _lse_to_padded(lse, s_pad):
+    """(B, S, H) -> (B*H, S_pad, 1) f32 (zero padding; kernels mask pads)."""
+    b, s, h = lse.shape
+    out = lse.transpose(0, 2, 1).reshape(b * h, s, 1).astype(jnp.float32)
+    if s_pad > s:
+        out = jnp.pad(out, ((0, 0), (0, s_pad - s), (0, 0)))
+    return out
+
+
+def flash_block_fwd(q, k, v, causal: bool = False, interpret: bool | None = None):
+    """One flash forward returning ``(out, lse)``, lse shaped (B, S, H).
+
+    The ring-attention building block (parallel/ring_attention.py): the
+    normalized block output plus its row logsumexp is exactly what the
+    cross-device online-softmax merge needs to combine K/V blocks that live
+    on different chips.  NOT differentiable — the ring writes its own VJP
+    from :func:`flash_block_bwd`.
+    """
+    out, (_, _, _, _, lse_p) = _flash_fwd(q, k, v, causal, interpret)
+    b, s, h, _ = q.shape
+    return out, _lse_to_bsh(lse_p, b, s, h)
+
+
+def flash_block_bwd(q, k, v, g, lse, delta, causal: bool = False,
+                    interpret: bool | None = None):
+    """Per-block flash backward under GLOBAL row statistics.
+
+    ``lse``/``delta`` are (B, S, H) f32 for the FULL (ring-merged) softmax;
+    returns this block's ``(dq_contribution, dk, dv)``.  With the true
+    global statistics, ``p = exp(scores - lse)`` reproduces each block's
+    share of the softmax exactly, so summing dq over blocks (and letting
+    dk/dv ride the ring home) is the standard flash/ring backward.
+    """
+    s_pad = q.shape[1] + ((-q.shape[1]) % 8)
+    lse_p = _lse_to_padded(lse, s_pad)
+    delta_p = _lse_to_padded(delta, s_pad)
+    return _bwd_calls(q, k, v, g, lse_p, delta_p, causal, interpret)
 
 
 def flash_attention(

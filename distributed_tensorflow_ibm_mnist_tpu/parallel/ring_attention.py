@@ -93,21 +93,161 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     return out.astype(dtype)
 
 
+_NEG = -1e30  # matches ops/flash_attention._NEG (empty-accumulator sentinel)
+
+
+def _merge_block(m_run, l_run, o_run, out_blk, lse_blk):
+    """Online-softmax merge of one NORMALIZED flash block into the running
+    (max, weight-sum, output-numerator) accumulators, all (B, S, H[, D]).
+
+    ``out_blk * exp(lse_blk - m_new)`` is the block's rescaled numerator
+    (out_blk = acc/l and lse = m + log l, so the l cancels).  A skipped /
+    fully-masked block arrives with lse = _NEG: its weight underflows to 0
+    against any real max, and while only _NEG blocks have been seen the
+    spurious weight it adds (exp(0)=1) multiplies a zero numerator and is
+    annihilated by ``corr`` the moment a real block lands.
+    """
+    m_new = jnp.maximum(m_run, lse_blk)
+    corr = jnp.exp(m_run - m_new)
+    w_blk = jnp.exp(lse_blk - m_new)
+    l_new = l_run * corr + w_blk
+    o_new = o_run * corr[..., None] + out_blk.astype(jnp.float32) * w_blk[..., None]
+    return m_new, l_new, o_new
+
+
+def _ring_flash_fwd_loop(q, k, v, axis_name, causal, interpret):
+    """n flash-block calls + n-1 ppermute hops -> (out, global lse)."""
+    from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_block_fwd
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    m_run = jnp.full((b, s_local, h), _NEG, jnp.float32)
+    l_run = jnp.zeros((b, s_local, h), jnp.float32)
+    o_run = jnp.zeros((b, s_local, h, d), jnp.float32)
+    k_blk, v_blk = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # Static unroll over ring steps (n is a compile-time mesh size): after r
+    # hops this shard holds the block born on shard my-r, so under causal
+    # masking each step is one of exactly three STATIC cases — diagonal
+    # (r=0: in-block causal), fully visible (my >= r), or fully masked
+    # (my < r: skip, no FLOPs) — no per-position cross-block offsets needed.
+    for r in range(n):
+        if causal and r > 0:
+            out_blk, lse_blk = lax.cond(
+                my >= r,
+                lambda kv: flash_block_fwd(q, kv[0], kv[1], causal=False,
+                                           interpret=interpret),
+                lambda kv: (jnp.zeros_like(q),
+                            jnp.full((b, s_local, h), _NEG, jnp.float32)),
+                (k_blk, v_blk),
+            )
+        else:
+            out_blk, lse_blk = flash_block_fwd(
+                q, k_blk, v_blk, causal=causal and r == 0, interpret=interpret
+            )
+        m_run, l_run, o_run = _merge_block(m_run, l_run, o_run, out_blk, lse_blk)
+        if r < n - 1:
+            k_blk, v_blk = jax.tree.map(
+                lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk)
+            )
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    out = (o_run / l_safe[..., None]).astype(q.dtype)
+    lse = m_run + jnp.log(l_safe)
+    return out, lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd_loop(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, res, g):
+    """Ring backward: dq accumulates locally; each K/V block's (dk, dv)
+    rides the ring WITH the block and lands home after n hops."""
+    from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_block_bwd
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    k_blk, v_blk = k, v
+    dk_blk = jnp.zeros(k.shape, jnp.float32)
+    dv_blk = jnp.zeros(v.shape, jnp.float32)
+    for r in range(n):
+        if causal and r > 0:
+            dq_c, dk_c, dv_c = lax.cond(
+                my >= r,
+                lambda kv: flash_block_bwd(q, kv[0], kv[1], g, lse, delta,
+                                           causal=False, interpret=interpret),
+                lambda kv: (jnp.zeros_like(q), jnp.zeros_like(kv[0]),
+                            jnp.zeros_like(kv[1])),
+                (k_blk, v_blk),
+            )
+        else:
+            dq_c, dk_c, dv_c = flash_block_bwd(
+                q, k_blk, v_blk, g, lse, delta,
+                causal=causal and r == 0, interpret=interpret,
+            )
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_blk = dk_blk + dk_c.astype(jnp.float32)
+        dv_blk = dv_blk + dv_c.astype(jnp.float32)
+        # rotate the block AND its gradient accumulators every step: after
+        # the n-th hop each (dk, dv) is back on the shard that owns the block
+        k_blk, v_blk, dk_blk, dv_blk = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm),
+            (k_blk, v_blk, dk_blk, dv_blk),
+        )
+    return dq.astype(q.dtype), dk_blk.astype(k.dtype), dv_blk.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, interpret):
+    out, _ = _ring_flash_fwd_loop(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def make_ring_attention(
     mesh: Mesh,
     batch_axis: str | None = "data",
     seq_axis: str = "seq",
     causal: bool = False,
+    inner: str = "dense",
+    interpret: bool | None = None,
 ):
     """Build ``attn(q, k, v) -> out`` with the sequence sharded over ``seq_axis``.
 
     The returned callable is a ``shard_map`` island over ``(batch, seq)``:
     call it from GSPMD-jitted model code on (B, S, H, D) activations and the
     partitioner feeds it the local shards.  With ``seq_axis`` of size 1 it
-    degrades to exactly one (vanilla) block update.
+    degrades to exactly one block update.
+
+    ``inner`` picks the per-block computation:
+
+    * ``"dense"`` — f32 einsum block update (materializes one
+      (S_local x S_local) score block per step): simple, exact, fine for
+      short shards.
+    * ``"flash"`` — the Pallas flash kernel per block with logsumexp-merge
+      across ring steps and a hand-written ring VJP (dk/dv ride the ring
+      home).  Per-device memory drops from O(S_local^2) to O(S_local), so
+      the 32k-per-chip single-kernel ceiling (docs/PERFORMANCE.md) times
+      the ring size becomes the total context length; under ``causal`` the
+      fully-masked ring steps skip their FLOPs entirely.
     """
+    if inner not in ("dense", "flash"):
+        raise ValueError(f"unknown ring inner {inner!r}; use 'dense' or 'flash'")
     spec = P(batch_axis, seq_axis, None, None)
-    fn = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal)
+    if inner == "flash":
+        # positional: custom_vjp nondiff_argnums don't mix with kwargs
+        def fn(q, k, v):
+            return _ring_flash(q, k, v, seq_axis, causal, interpret)
+    else:
+        fn = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal)
     island = shard_map_compat(fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
     b_size = mesh.shape[batch_axis] if batch_axis is not None else 1
     s_size = mesh.shape[seq_axis]
@@ -117,6 +257,12 @@ def make_ring_attention(
         # axes (model.init's batch-1 sample, tiny eval remainders), the ring
         # is skipped for the numerically-identical dense path.
         if q.shape[0] % b_size or q.shape[1] % s_size:
+            if inner == "flash":
+                from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import (
+                    flash_attention,
+                )
+
+                return flash_attention(q, k, v, causal=causal, interpret=interpret)
             return vanilla_attention(q, k, v, causal=causal)
         return island(q, k, v)
 
