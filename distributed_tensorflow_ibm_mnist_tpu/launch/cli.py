@@ -32,6 +32,10 @@ def _parse_override(kv: str) -> tuple[str, object]:
 
 
 def build_config(argv: list[str] | None = None) -> RunConfig:
+    return _build(argv)[0]
+
+
+def _build(argv: list[str] | None = None) -> tuple[RunConfig, argparse.Namespace]:
     parser = argparse.ArgumentParser(
         prog="distributed_tensorflow_ibm_mnist_tpu.launch.cli",
         description="TPU-native trainer (see BASELINE.md for the preset configs)",
@@ -47,6 +51,11 @@ def build_config(argv: list[str] | None = None) -> RunConfig:
     parser.add_argument(
         "--resume", action="store_true",
         help="restore the latest checkpoint from checkpoint_dir before training",
+    )
+    parser.add_argument(
+        "--throughput", type=int, default=None, metavar="EPOCHS",
+        help="measure steady-state throughput/MFU over EPOCHS chained epochs "
+        "(Trainer.measure_throughput) instead of training; prints one JSON line",
     )
     parser.add_argument(
         "--coordinator", default=None,
@@ -69,14 +78,19 @@ def build_config(argv: list[str] | None = None) -> RunConfig:
     unknown = set(overrides) - set(config.to_dict())
     if unknown:
         parser.error(f"unknown config fields: {sorted(unknown)}")
-    return config.replace(**overrides)
+    return config.replace(**overrides), args
 
 
 def main(argv: list[str] | None = None) -> int:
     from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
 
-    config = build_config(argv)
-    summary = Trainer(config).fit()
+    config, args = _build(argv)
+    trainer = Trainer(config)
+    if args.throughput:
+        out = trainer.measure_throughput(epochs=args.throughput)
+        print(json.dumps({"kind": "throughput", **out}), flush=True)
+        return 0
+    summary = trainer.fit()
     print(json.dumps({"kind": "final", **summary}), flush=True)
     return 0
 

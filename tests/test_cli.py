@@ -114,3 +114,21 @@ def test_trainer_param_count_at_dp8(eight_devices):
     summary = t.fit()
     expected = 28 * 28 * 32 + 32 + 32 * 10 + 10
     assert summary["param_count"] == expected
+
+
+def test_cli_throughput_mode(capsys):
+    """--throughput N prints one JSON line from measure_throughput."""
+    import json
+
+    from distributed_tensorflow_ibm_mnist_tpu.launch.cli import main
+
+    rc = main([
+        "--set", "model='mlp'", "--set", "model_kwargs={'hidden': (16,)}",
+        "--set", "synthetic=True", "--set", "n_train=128", "--set", "n_test=32",
+        "--set", "batch_size=32", "--set", "quiet=True",
+        "--set", "eval_batch_size=32", "--throughput", "2",
+    ])
+    assert rc == 0
+    line = [l for l in capsys.readouterr().out.splitlines() if '"throughput"' in l][0]
+    out = json.loads(line)
+    assert out["epochs"] == 2 and out["images_per_sec"] > 0
