@@ -58,6 +58,12 @@ def _build(argv: list[str] | None = None) -> tuple[RunConfig, argparse.Namespace
         "(Trainer.measure_throughput) instead of training; prints one JSON line",
     )
     parser.add_argument(
+        "--virtual-devices", type=int, default=None, metavar="N",
+        help="dev machines: rebuild jax onto an N-device virtual CPU mesh "
+        "before training (utils/hostmesh) — lets dp/tp/sp/pp configs run "
+        "where only one (or no) accelerator is attached",
+    )
+    parser.add_argument(
         "--coordinator", default=None,
         help="multi-host: coordinator address for jax.distributed.initialize",
     )
@@ -85,6 +91,15 @@ def main(argv: list[str] | None = None) -> int:
     from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
 
     config, args = _build(argv)
+    if args.virtual_devices:
+        import jax
+
+        if len(jax.devices()) < args.virtual_devices:
+            from distributed_tensorflow_ibm_mnist_tpu.utils.hostmesh import (
+                ensure_virtual_cpu_devices,
+            )
+
+            ensure_virtual_cpu_devices(args.virtual_devices)
     trainer = Trainer(config)
     if args.throughput:
         out = trainer.measure_throughput(epochs=args.throughput)
