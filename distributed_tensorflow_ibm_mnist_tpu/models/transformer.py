@@ -116,6 +116,8 @@ class StackedBlocks(nn.Module):
     attn_fn: Callable | None = None
     attn: str = "vanilla"
     pipeline_fn: Callable | None = None
+    block_remat: bool = False  # jax.checkpoint each block inside the stage
+    #   scan: the pipeline's backward keeps only block-boundary residuals
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -142,10 +144,13 @@ class StackedBlocks(nn.Module):
             return jax.tree.map(lambda *a: jnp.stack(a), *stages)
 
         stacked = self.param("stacked", init_fn)
+        block_apply = lambda p, c: block.apply({"params": p}, c, train=False)
+        if self.block_remat:
+            block_apply = jax.checkpoint(block_apply)
 
         def stage_fn(stage_params, h):
             def body(c, p):
-                return block.apply({"params": p}, c, train=False), None
+                return block_apply(p, c), None
 
             out, _ = lax.scan(body, h, stage_params)
             return out
@@ -214,7 +219,7 @@ class VisionTransformer(nn.Module):
                 dim=self.dim, heads=self.heads, n_stages=self.pp_stages,
                 per_stage=self.depth // self.pp_stages, mlp_ratio=self.mlp_ratio,
                 attn_fn=self.attn_fn, attn=self.attn, pipeline_fn=self.pipeline_fn,
-                dtype=self.dtype, name="pipe_blocks",
+                block_remat=self.block_remat, dtype=self.dtype, name="pipe_blocks",
             )(x, train=train)
             x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
             x = x.mean(axis=1)

@@ -180,3 +180,29 @@ def test_pp_rejects_bad_compositions(eight_devices):
     with pytest.raises(ValueError, match="multiple"):
         Trainer(RunConfig(model="vit", pp=2, dp=2, batch_size=30,
                           **{k: v for k, v in kw.items() if k != "batch_size"}))
+
+
+def test_pp_with_block_remat(eight_devices):
+    """remat='blocks' reaches the pipelined stack: identical trajectory, the
+    backward just recomputes within-block activations."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="vit",
+        model_kwargs={"patch_size": 7, "dim": 16, "depth": 2, "heads": 2,
+                      "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=128, n_test=32,
+        batch_size=32, epochs=1, lr=1e-3, dp=2, pp=2, quiet=True,
+        eval_batch_size=32, seed=9,
+    )
+    t1 = Trainer(RunConfig(name="plain", **base))
+    t1.fit()
+    t2 = Trainer(RunConfig(name="remat", remat="blocks", **base))
+    assert t2.model.block_remat is True
+    t2.fit()
+    a, b = jax.device_get((t1.state.params, t2.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-4)
