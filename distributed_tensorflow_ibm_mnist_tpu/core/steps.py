@@ -265,11 +265,18 @@ def make_eval_fn(model, batch_size: int = 2000, n_valid: int | None = None, mesh
         n_batches = -(-n // batch_size)
         pad = n_batches * batch_size - n
         images_p = jnp.pad(images, ((0, pad),) + ((0, 0),) * (images.ndim - 1))
-        labels_p = jnp.pad(labels, ((0, pad),))
+        labels_p = jnp.pad(labels, ((0, pad),) + ((0, 0),) * (labels.ndim - 1))
         valid = (jnp.arange(n_batches * batch_size) < true_n).astype(jnp.float32)
         images_b = images_p.reshape((n_batches, batch_size) + images.shape[1:])
-        labels_b = labels_p.reshape(n_batches, batch_size)
+        labels_b = labels_p.reshape((n_batches, batch_size) + labels.shape[1:])
         valid_b = valid.reshape(n_batches, batch_size)
+        # per-position labels (causal LM: (N, S)) score every position; the
+        # per-SAMPLE validity mask broadcasts over the extra label dims and
+        # the denominator counts scored elements, not sequences
+        per_sample = 1
+        for d in labels.shape[1:]:
+            per_sample *= d
+        v_shape = (batch_size,) + (1,) * (labels.ndim - 1)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -277,7 +284,7 @@ def make_eval_fn(model, batch_size: int = 2000, n_valid: int | None = None, mesh
                 return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
             images_b = constrain(images_b, P(None, data_axis, *([None] * (images.ndim - 1))))
-            labels_b = constrain(labels_b, P(None, data_axis))
+            labels_b = constrain(labels_b, P(None, data_axis, *([None] * (labels.ndim - 1))))
             valid_b = constrain(valid_b, P(None, data_axis))
 
         def body(carry, xs):
@@ -286,13 +293,15 @@ def make_eval_fn(model, batch_size: int = 2000, n_valid: int | None = None, mesh
                 state.params, state.batch_stats, {"image": imgs, "label": labs},
                 jax.random.PRNGKey(0), train=False,
             )
-            correct = jnp.sum((logits.argmax(-1) == labs) * v)
+            vb = v.reshape(v_shape)
+            correct = jnp.sum((logits.argmax(-1) == labs) * vb)
             losses = optax.softmax_cross_entropy_with_integer_labels(logits, labs)
-            return (carry[0] + correct, carry[1] + jnp.sum(losses * v)), None
+            return (carry[0] + correct, carry[1] + jnp.sum(losses * vb)), None
 
         (correct, loss_sum), _ = jax.lax.scan(
             body, (jnp.zeros(()), jnp.zeros(())), (images_b, labels_b, valid_b)
         )
-        return {"accuracy": correct / true_n, "loss": loss_sum / true_n}
+        denom = true_n * per_sample
+        return {"accuracy": correct / denom, "loss": loss_sum / denom}
 
     return eval_fn

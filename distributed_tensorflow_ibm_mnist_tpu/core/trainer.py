@@ -104,7 +104,7 @@ class Trainer:
 
         data = load_dataset(
             config.dataset, n_train=config.n_train, n_test=config.n_test,
-            seed=config.seed, synthetic=config.synthetic,
+            seed=config.seed, synthetic=config.synthetic, **config.dataset_kwargs,
         )
         self.num_classes = data["num_classes"]
         # which source synthetic=None actually resolved to (provenance)
@@ -316,7 +316,7 @@ class Trainer:
                 self._tp_specs = make_param_specs(state.params, chain_rules(*rules))
             self._run_epoch = make_tp_epoch_runner(
                 self.model, self.tx, self.mesh, self._tp_specs, state,
-                config.batch_size, **step_kw,
+                config.batch_size, img_ndim=data["train_images"].ndim, **step_kw,
             )
             self.train_images, self.train_labels = shard_dataset(
                 self.mesh, data["train_images"], data["train_labels"]

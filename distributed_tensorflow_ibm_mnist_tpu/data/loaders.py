@@ -126,17 +126,21 @@ def load_dataset(
     n_test: int | None = None,
     seed: int = 0,
     synthetic: bool | None = None,
+    **dataset_kwargs,
 ) -> dict[str, np.ndarray]:
-    """Load ``mnist`` | ``fashion_mnist`` | ``cifar10``.
+    """Load ``mnist`` | ``fashion_mnist`` | ``cifar10`` | ``retrieval``.
 
     ``synthetic=None`` (default) tries real caches first then falls back;
     ``True`` forces synthetic; ``False`` requires real data (raises if absent).
-    Returns uint8 images (N, H, W, C), int32 labels, ``num_classes``.
+    Returns uint8 images (N, H, W, C), int32 labels, ``num_classes`` — except
+    ``retrieval`` (synthetic-only token sequences for the ``causal_lm``
+    model: int32 (N, seq_len) tokens with per-position labels; extra
+    ``dataset_kwargs`` like ``vocab``/``seq_len`` reach the generator).
     """
-    if name not in ("mnist", "fashion_mnist", "cifar10"):
+    if name not in ("mnist", "fashion_mnist", "cifar10", "retrieval"):
         raise ValueError(f"unknown dataset {name!r}")
     real = None
-    if synthetic is not True:
+    if synthetic is not True and name != "retrieval":
         try:
             if name == "mnist":
                 real = _try_real_mnist()
@@ -152,14 +156,17 @@ def load_dataset(
             real = None
         if real is None and synthetic is False:
             raise FileNotFoundError(f"real {name} requested but no local cache found")
+    elif name == "retrieval" and synthetic is False:
+        raise ValueError("retrieval is a synthetic-only dataset")
 
     if real is None:
         gen = {
             "mnist": _syn.synthetic_mnist,
             "fashion_mnist": _syn.synthetic_fashion_mnist,
             "cifar10": _syn.synthetic_cifar10,
+            "retrieval": _syn.synthetic_retrieval,
         }[name]
-        kwargs = {"seed": seed}
+        kwargs = {"seed": seed, **dataset_kwargs}
         if n_train is not None:
             kwargs["n_train"] = n_train
         if n_test is not None:

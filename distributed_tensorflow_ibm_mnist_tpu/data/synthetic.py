@@ -283,3 +283,36 @@ def synthetic_cifar10(
         "test_labels": test_y,
         "num_classes": 10,
     }
+
+
+def synthetic_retrieval(
+    n_train: int = 8192, n_test: int = 1024, seed: int = 0,
+    vocab: int = 64, seq_len: int = 256,
+) -> dict[str, np.ndarray]:
+    """Long-context key-retrieval language-modeling task (token sequences).
+
+    Token 0 of each sequence is a random key, every later input token is
+    noise, and the label at position t is ``(key + t) mod vocab`` — so a
+    model must attend across the whole context to beat the uniform
+    ``-log(1/vocab)`` loss floor (the examples/06 task, promoted to a
+    first-class dataset for the ``causal_lm`` zoo model).  "images" here are
+    (N, seq_len) int32 token arrays; labels are per-position (N, seq_len).
+    """
+
+    def split(n, s):
+        rng = np.random.default_rng(s)
+        key = rng.integers(0, vocab, (n, 1))
+        noise = rng.integers(0, vocab, (n, seq_len - 1))
+        tokens = np.concatenate([key, noise], axis=1).astype(np.int32)
+        labels = ((key + np.arange(seq_len)[None, :]) % vocab).astype(np.int32)
+        return tokens, labels
+
+    train_x, train_y = split(n_train, seed * 2 + 1)
+    test_x, test_y = split(n_test, seed * 2 + 2)
+    return {
+        "train_images": train_x,
+        "train_labels": train_y,
+        "test_images": test_x,
+        "test_labels": test_y,
+        "num_classes": vocab,
+    }

@@ -11,6 +11,7 @@ Every model follows one calling convention:
 
 from __future__ import annotations
 
+from distributed_tensorflow_ibm_mnist_tpu.models.causal_lm import CausalLM
 from distributed_tensorflow_ibm_mnist_tpu.models.lenet import LeNet5
 from distributed_tensorflow_ibm_mnist_tpu.models.mlp import MLP
 from distributed_tensorflow_ibm_mnist_tpu.models.resnet import ResNet, ResNet20, ResNet50
@@ -22,6 +23,7 @@ _REGISTRY = {
     "resnet20": ResNet20,
     "resnet50": ResNet50,
     "vit": VisionTransformer,
+    "causal_lm": CausalLM,
 }
 
 
@@ -52,4 +54,4 @@ def model_accepts(name: str, param: str) -> bool:
         return False
 
 
-__all__ = ["MLP", "LeNet5", "ResNet", "ResNet20", "ResNet50", "get_model", "available_models", "model_accepts"]
+__all__ = ["CausalLM", "MLP", "LeNet5", "ResNet", "ResNet20", "ResNet50", "VisionTransformer", "get_model", "available_models", "model_accepts"]

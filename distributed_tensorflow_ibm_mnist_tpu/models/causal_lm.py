@@ -1,0 +1,85 @@
+"""Decoder-only causal language model — the zoo's text/sequence family.
+
+The reference's model layer was a single image CNN (SURVEY.md §1 L3); this
+is the rebuild's language-model counterpart, promoted from the hand-rolled
+examples/06 net so the long-context machinery is config-driven end to end:
+
+    RunConfig(model="causal_lm", dataset="retrieval", causal=True,
+              sp=4, sp_impl="ring", model_kwargs={"attn": "flash"})
+
+Inputs are int token arrays (B, S); logits are per-position (B, S, vocab)
+and the framework's loss/accuracy/eval paths handle the extra position axis
+unchanged (per-token cross-entropy and accuracy).  Attention is causal by
+default; a trainer-supplied ``attn_fn`` (the sp ring/Ulysses island) takes
+priority, carrying its own causal flag from ``RunConfig.causal`` — set
+``causal=True`` there or the sp island will attend bidirectionally.
+
+Reuses :class:`~.transformer.TransformerBlock`, so TP (qkv/proj Megatron
+specs), MoE blocks, and block remat all apply as they do to the ViT.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_ibm_mnist_tpu.models.transformer import TransformerBlock
+from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
+
+
+class CausalLM(nn.Module):
+    """Embed -> pre-norm causal blocks -> per-position vocab head."""
+
+    num_classes: int = 64  # vocabulary size (named for zoo consistency)
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attn_fn: Callable | None = None  # sp island (brings its OWN causal flag)
+    attn: str = "vanilla"  # 'vanilla' | 'flash' for the local kernels
+    causal: bool = True
+    moe_every: int = 0
+    n_experts: int = 8
+    moe_capacity_factor: float = 2.0
+    moe_fn: Callable | None = None
+    block_remat: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        b, s = tokens.shape
+        x = nn.Embed(self.num_classes, self.dim, dtype=self.dtype, name="embed")(
+            tokens.astype(jnp.int32)
+        )
+        pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, s, self.dim))
+        x = x + pos.astype(self.dtype)
+        attn_fn = self.attn_fn
+        if attn_fn is None:
+            if self.attn == "flash":
+                from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import (
+                    flash_attention,
+                )
+
+                attn_fn = partial(flash_attention, causal=self.causal)
+            else:
+                attn_fn = partial(vanilla_attention, causal=self.causal)
+        block_cls = (
+            nn.remat(TransformerBlock, static_argnums=(2,))
+            if self.block_remat
+            else TransformerBlock
+        )
+        for i in range(self.depth):
+            x = block_cls(
+                dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout, attn_fn=attn_fn,
+                use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
+                n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
+                moe_fn=self.moe_fn, dtype=self.dtype, name=f"block_{i}",
+            )(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        return x.astype(jnp.float32)

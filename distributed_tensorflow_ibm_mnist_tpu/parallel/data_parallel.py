@@ -79,7 +79,7 @@ def shard_eval_set(mesh: Mesh, images: np.ndarray, labels: np.ndarray, axis: str
     pad = (-n) % size
     if pad:
         images = np.pad(images, ((0, pad),) + ((0, 0),) * (images.ndim - 1))
-        labels = np.pad(labels, ((0, pad),))
+        labels = np.pad(labels, ((0, pad),) + ((0, 0),) * (labels.ndim - 1))
     spec_img = P(axis, *([None] * (images.ndim - 1)))
 
     def _place(host: np.ndarray, spec: P):

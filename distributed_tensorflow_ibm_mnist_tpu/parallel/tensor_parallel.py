@@ -238,10 +238,13 @@ def make_tp_train_step(
     )
 
 
-def _tp_shardings(mesh: Mesh, state: TrainState, param_specs, data_axis: str):
-    """(state, image, label, metric) NamedShardings for the DP x TP layout."""
+def _tp_shardings(mesh: Mesh, state: TrainState, param_specs, data_axis: str,
+                  img_ndim: int = 4):
+    """(state, image, label, metric) NamedShardings for the DP x TP layout.
+
+    ``img_ndim``: rank of the input batch (4 for NHWC images, 2 for token
+    sequences) so the spec's trailing dims match the data."""
     st_shard = state_shardings(mesh, state, param_specs)
-    img_ndim = 4  # NHWC
     img_shard = NamedSharding(mesh, P(data_axis, *([None] * (img_ndim - 1))))
     lab_shard = NamedSharding(mesh, P(data_axis))
     metric_shard = NamedSharding(mesh, P())
@@ -260,6 +263,7 @@ def make_tp_epoch_runner(
     fused_xent: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    img_ndim: int = 4,
 ):
     """Whole-epoch scan under DP x TP GSPMD shardings — the Trainer's TP path.
 
@@ -276,7 +280,7 @@ def make_tp_epoch_runner(
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
     st_shard, img_shard, lab_shard, metric_shard = _tp_shardings(
-        mesh, state, param_specs, data_axis
+        mesh, state, param_specs, data_axis, img_ndim=img_ndim
     )
     return jax.jit(
         run_epoch,

@@ -23,6 +23,8 @@ class RunConfig:
     model_kwargs: dict[str, Any] = field(default_factory=dict)
     # data
     dataset: str = "mnist"
+    dataset_kwargs: dict[str, Any] = field(default_factory=dict)  # generator
+    #   extras, e.g. {"vocab": 64, "seq_len": 1024} for dataset="retrieval"
     synthetic: bool | None = None  # None = real cache if present, else synthetic
     n_train: int | None = None
     n_test: int | None = None

@@ -1,0 +1,61 @@
+"""causal_lm zoo model + retrieval dataset: the config-driven LM family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+BASE = dict(
+    model="causal_lm",
+    model_kwargs={"dim": 64, "depth": 2, "heads": 4, "dtype": jnp.float32},
+    dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 64},
+    n_train=512, n_test=100, batch_size=64, lr=3e-3,
+    quiet=True, eval_batch_size=48, seed=0,
+)
+
+
+def test_causal_lm_trains_on_retrieval():
+    """Per-token loss falls well below the uniform floor within a few epochs,
+    and the 2-D-label eval path (odd n_test, pad + per-position mask) yields
+    sane metrics."""
+    cfg = RunConfig(name="lm", epochs=12, eval_every=12,
+                    **{**BASE, "n_train": 2048})
+    t = Trainer(cfg)
+    s = t.fit()
+    losses = [h["train_loss"] for h in t.history]
+    # the retrieval head needs a few hundred steps to emerge; by ~380 steps
+    # the loss must be clearly below the 2.77 uniform floor
+    assert losses[-1] < 2.0, losses
+    assert 0.0 <= s["best_test_accuracy"] <= 1.0
+    assert np.isfinite(s["best_test_accuracy"])
+
+
+def test_causal_lm_sp_ring_matches_dense(eight_devices):
+    """dp=1 x sp=4 ring (causal plumbed from config) reproduces the dp=1
+    trajectory — same batches, attention island vs local kernel."""
+    cfg1 = RunConfig(name="lm_1", epochs=2, **BASE)
+    t1 = Trainer(cfg1)
+    t1.fit()
+    cfg_sp = RunConfig(name="lm_sp", epochs=2, dp=1, sp=4, causal=True, **BASE)
+    t_sp = Trainer(cfg_sp)
+    t_sp.fit()
+    a, b = jax.device_get((t1.state.params, t_sp.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-3)
+
+
+def test_retrieval_dataset_synthetic_only():
+    from distributed_tensorflow_ibm_mnist_tpu.data import load_dataset
+
+    with pytest.raises(ValueError, match="synthetic-only"):
+        load_dataset("retrieval", synthetic=False)
+    d = load_dataset("retrieval", n_train=32, n_test=8, vocab=8, seq_len=16)
+    assert d["train_images"].shape == (32, 16)
+    assert d["train_labels"].shape == (32, 16)
+    assert d["num_classes"] == 8
+    # labels encode (key + t) mod vocab
+    key = d["train_images"][:, 0]
+    np.testing.assert_array_equal(d["train_labels"][:, 0], key % 8)
