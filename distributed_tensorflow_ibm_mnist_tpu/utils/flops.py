@@ -10,6 +10,14 @@ excludes ops the compiler folded away, and under SPMD shardings reports the
 per-device program's FLOPs (verified: an 8-way-sharded matmul reports 1/8
 the single-device count), which is exactly the numerator MFU needs.
 
+Two caveats, both verified on this backend: (1) a while-loop body is
+counted ONCE regardless of trip count — callers must scale by their scan
+trips (Trainer._epoch_flops does); (2) custom calls — Pallas kernels —
+report no FLOPs (the sentinel -2), so for models running flash attention
+the reported MFU is a LOWER bound that excludes the attention FLOPs
+entirely; throughput (images- or tokens-per-sec) is the cross-model
+comparable number there.
+
 MFU denominator: the chip's peak matmul throughput at the dtype the model
 computes in (bf16 for the zoo's default).  Peaks are keyed on
 ``device_kind`` from public TPU specs; ``$DTM_PEAK_TFLOPS`` overrides for
