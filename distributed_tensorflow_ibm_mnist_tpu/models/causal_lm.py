@@ -46,6 +46,9 @@ class CausalLM(nn.Module):
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
     moe_fn: Callable | None = None
+    pp_stages: int = 0  # >0: stack blocks for the GPipe island (see the
+    #                     ViT's StackedBlocks; params shardable over 'pipe')
+    pipeline_fn: Callable | None = None  # (stage_fn, stacked_params, x) -> y
     block_remat: bool = False
     dtype: jnp.dtype = jnp.bfloat16
 
@@ -67,6 +70,29 @@ class CausalLM(nn.Module):
                 attn_fn = partial(flash_attention, causal=self.causal)
             else:
                 attn_fn = partial(vanilla_attention, causal=self.causal)
+        if self.pp_stages > 0:
+            from distributed_tensorflow_ibm_mnist_tpu.models.transformer import (
+                StackedBlocks,
+            )
+
+            if self.depth % self.pp_stages:
+                raise ValueError(
+                    f"depth {self.depth} not divisible by pp_stages {self.pp_stages}"
+                )
+            if self.dropout > 0.0 or self.moe_every > 0:
+                raise ValueError(
+                    "pipeline stages need identical per-block programs: "
+                    "dropout and MoE blocks don't compose with pp_stages"
+                )
+            x = StackedBlocks(
+                dim=self.dim, heads=self.heads, n_stages=self.pp_stages,
+                per_stage=self.depth // self.pp_stages, mlp_ratio=self.mlp_ratio,
+                attn_fn=attn_fn, pipeline_fn=self.pipeline_fn,
+                block_remat=self.block_remat, dtype=self.dtype, name="pipe_blocks",
+            )(x, train=train)
+            x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
+            x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+            return x.astype(jnp.float32)
         block_cls = (
             nn.remat(TransformerBlock, static_argnums=(2,))
             if self.block_remat

@@ -59,3 +59,27 @@ def test_retrieval_dataset_synthetic_only():
     # labels encode (key + t) mod vocab
     key = d["train_images"][:, 0]
     np.testing.assert_array_equal(d["train_labels"][:, 0], key % 8)
+
+
+def test_causal_lm_pipeline_parallel(eight_devices):
+    """RunConfig(pp=2) pipelines the LM block stack like the ViT's: stacked
+    causal blocks sharded over 'pipe', trajectory equal to the local scan."""
+    base = dict(
+        model="causal_lm",
+        model_kwargs={"dim": 32, "depth": 2, "heads": 2, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=256, n_test=64, batch_size=32, epochs=1, lr=1e-3,
+        quiet=True, eval_batch_size=32, seed=1,
+    )
+    t_pp = Trainer(RunConfig(name="lm_pp", dp=2, pp=2, **base))
+    leaf = jax.tree.leaves(t_pp.state.params["pipe_blocks"]["stacked"])[0]
+    assert leaf.sharding.spec[0] == "pipe"
+    t_pp.fit()
+
+    mk = dict(base["model_kwargs"])
+    mk["pp_stages"] = 2
+    t_1 = Trainer(RunConfig(name="lm_1", dp=1, **{**base, "model_kwargs": mk}))
+    t_1.fit()
+    a, b = jax.device_get((t_pp.state.params, t_1.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
