@@ -126,6 +126,8 @@ def megatron_rule(n_shards: int, axis: str = "model") -> SpecRule:
                 return P(None, axis)
             if name == "logits" and d_in % n_shards == 0:
                 return P(axis, None)
+        if kind == "embedding" and ndim == 2 and shape[1] % n_shards == 0:
+            return P(None, axis)  # token embedding: feature dim sharded
         if kind == "bias" and ndim == 1 and shape[0] % n_shards == 0:
             if name == "qkv" or re.fullmatch(r"fc\d*", name):
                 return P(axis)  # match the column-parallel output sharding

@@ -83,3 +83,30 @@ def test_causal_lm_pipeline_parallel(eight_devices):
     a, b = jax.device_get((t_pp.state.params, t_1.state.params))
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
+
+
+def test_causal_lm_tensor_parallel(eight_devices):
+    """tp=4 on the LM: embedding feature dim, qkv/proj, MLP pair, and head
+    all sharded over 'model'; trajectory matches single-device."""
+    from jax.sharding import PartitionSpec as P
+
+    base = dict(
+        model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 2, "heads": 4, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=256, n_test=64, batch_size=32, epochs=1, lr=1e-3,
+        quiet=True, eval_batch_size=32, seed=2,
+    )
+    t_tp = Trainer(RunConfig(name="lm_tp", dp=2, tp=4, **base))
+    p = t_tp.state.params
+    assert p["embed"]["embedding"].sharding.spec == P(None, "model")
+    assert p["block_0"]["qkv"]["kernel"].sharding.spec == P(None, "model")
+    assert p["block_0"]["proj"]["kernel"].sharding.spec == P("model", None)
+    assert p["logits"]["kernel"].sharding.spec == P("model", None)
+    t_tp.fit()
+
+    t_1 = Trainer(RunConfig(name="lm_one", dp=1, **base))
+    t_1.fit()
+    a, b = jax.device_get((t_tp.state.params, t_1.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
