@@ -177,7 +177,10 @@ class Prefetcher:
         self._img_bytes = images.dtype.itemsize * int(np.prod(images.shape[1:], dtype=np.int64))
         self._lib = _load()
         self._handle = None
-        if self._lib is not None:
+        # The C fast path copies ONE int32 label per sample; per-position
+        # label arrays (causal LM: (N, S)) take the numpy path below, which
+        # gathers label rows of any rank.
+        if self._lib is not None and self._labels.ndim == 1:
             self._handle = self._lib.dtm_prefetch_create(
                 _ptr(self._images.view(np.uint8).reshape(images.shape[0], -1), _u8p),
                 _ptr(self._labels, _i32p),

@@ -110,3 +110,17 @@ def test_causal_lm_tensor_parallel(eight_devices):
     a, b = jax.device_get((t_tp.state.params, t_1.state.params))
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
+
+
+def test_causal_lm_stream_mode():
+    """Per-position labels route around the scalar-label C prefetcher fast
+    path; stream mode trains the LM end to end."""
+    t = Trainer(RunConfig(
+        name="lm_stream", model="causal_lm",
+        model_kwargs={"dim": 32, "depth": 1, "heads": 2, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=256, n_test=64, batch_size=32, epochs=1, lr=1e-3,
+        input_mode="stream", quiet=True, eval_batch_size=32,
+    ))
+    s = t.fit()
+    assert np.isfinite(s["best_test_accuracy"])
