@@ -124,3 +124,26 @@ def test_causal_lm_stream_mode():
     ))
     s = t.fit()
     assert np.isfinite(s["best_test_accuracy"])
+
+
+def test_causal_lm_fsdp_and_ulysses(eight_devices):
+    """The LM composes with the remaining config strategies: ZeRO-3 over
+    'data', and Ulysses causal SP."""
+    base = dict(
+        model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 1, "heads": 4, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=256, n_test=64, batch_size=64, epochs=1, lr=1e-3,
+        quiet=True, eval_batch_size=64, seed=3,
+    )
+    t_f = Trainer(RunConfig(name="lm_fsdp", dp=8, fsdp=True, **base))
+    spec = t_f.state.params["block_0"]["qkv"]["kernel"].sharding.spec
+    assert "data" in tuple(spec)
+    s = t_f.fit()
+    assert np.isfinite(s["best_test_accuracy"])
+
+    t_u = Trainer(RunConfig(
+        name="lm_uly", dp=2, sp=4, sp_impl="ulysses", causal=True, **base
+    ))
+    s = t_u.fit()
+    assert np.isfinite(s["best_test_accuracy"])
