@@ -9,8 +9,15 @@ is noise, and the label at position t is ``(key + t) mod V``.  A model
 that cannot attend ~1000 positions back to token 0 is stuck at the
 uniform -log(1/V) loss floor; the causal flash kernel drives it to ~0.
 On a multi-device mesh, swap the attention for
-``make_ring_attention(mesh, causal=True)`` or
+``make_ring_attention(mesh, causal=True, inner="flash")`` or
 ``make_ulysses_attention(...)`` — the same drop-in ``attn_fn`` slot.
+
+This walkthrough builds the net by hand to show the pieces; the same task
+is one config away since round 2::
+
+    RunConfig(model="causal_lm", dataset="retrieval", causal=True,
+              dataset_kwargs={"vocab": 64, "seq_len": 1024},
+              model_kwargs={"attn": "flash"})
 
     python examples/06_causal_lm_long_context.py
 """
