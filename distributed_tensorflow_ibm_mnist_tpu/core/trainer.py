@@ -599,6 +599,11 @@ class Trainer:
                 "mfu": (lambda v: round(v, 6) if v is not None else None)(_mfu(fps_chip)),
                 "last_loss": last_loss,
                 "device": str(jax.devices()[0]),
+                **(
+                    {"tokens_per_sec_per_chip": round(
+                        ips_chip * self.train_images.shape[1], 1)}
+                    if self.train_images.ndim == 2 else {}
+                ),
             }
         finally:
             # the warm call donated self.state's buffers — restore even on
@@ -755,6 +760,11 @@ class Trainer:
             # global leaf sizes: layout-independent, valid at any dp/tp/sp
             "param_count": self.state.param_count(),
         }
+        if self.train_images.ndim == 2:  # token sequences: report tokens/sec too
+            seq_len = self.train_images.shape[1]
+            summary["tokens_per_sec_per_chip"] = round(
+                images * seq_len / steady_mean / chips, 1
+            )
         flops_epoch = self._epoch_flops()
         if flops_epoch and steady_mean:
             from distributed_tensorflow_ibm_mnist_tpu.utils.flops import mfu as _mfu

@@ -198,6 +198,46 @@ def test_gspmd_path_on_real_tpu():
     assert "GSPMD_TPU_OK" in proc.stdout
 
 
+LM_GOLDEN = r'''
+import jax, numpy as np
+assert jax.devices()[0].platform == "tpu", jax.devices()
+from distributed_tensorflow_ibm_mnist_tpu.core import Trainer
+from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+cfg = RunConfig(
+    name="lm_golden", model="causal_lm",
+    model_kwargs={"dim": 128, "depth": 2, "heads": 4, "attn": "flash"},
+    dataset="retrieval", dataset_kwargs={"vocab": 64, "seq_len": 1024},
+    n_train=2048, n_test=64, batch_size=16, epochs=5, lr=3e-3, causal=True,
+    quiet=True, eval_batch_size=16, eval_every=5,
+)
+t = Trainer(cfg)
+s = t.fit()
+losses = [h["train_loss"] for h in t.history]
+# uniform floor = ln(64) = 4.16; the attend-to-key head must have emerged
+assert losses[-1] < 3.0, losses
+assert s["tokens_per_sec_per_chip"] > 50_000, s
+print("LM_GOLDEN_OK", losses[-1], s["tokens_per_sec_per_chip"], flush=True)
+'''
+
+
+@pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
+def test_causal_lm_golden_on_tpu():
+    """The config-driven long-context LM (causal flash attention, 1024-token
+    retrieval) learns the task on the real chip at sane token throughput."""
+    probe = subprocess.run(
+        [sys.executable, "-c", PROBE], capture_output=True, text=True,
+        timeout=120, cwd=str(REPO), env=_default_env(),
+    )
+    if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
+        pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
+    proc = subprocess.run(
+        [sys.executable, "-c", LM_GOLDEN], capture_output=True, text=True,
+        timeout=560, cwd=str(REPO), env=_default_env(),
+    )
+    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
+    assert "LM_GOLDEN_OK" in proc.stdout
+
+
 @pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
 def test_lenet_golden_metric_on_tpu():
     """SURVEY.md §4 golden-metric job: the [B:8] LeNet config on the real
