@@ -514,6 +514,13 @@ class Trainer:
         """Devices the run occupies: the images/sec/chip denominator."""
         return max(1, self.dp) * max(1, self.tp) * max(1, self.sp) * max(1, self.pp)
 
+    def _tokens_per_sec(self, sequences_per_sec: float) -> float | None:
+        """sequences/sec -> tokens/sec for token-sequence data (rank-2
+        inputs, i.e. the LM datasets); None for image data."""
+        if self.train_images.ndim != 2:
+            return None
+        return round(sequences_per_sec * self.train_images.shape[1], 1)
+
     def _epoch_flops(self) -> float | None:
         """Per-device FLOPs of one compiled epoch (XLA cost analysis of the
         post-partitioning module; None in stream mode / off-table backends).
@@ -585,7 +592,7 @@ class Trainer:
             from distributed_tensorflow_ibm_mnist_tpu.utils.flops import mfu as _mfu
 
             fps_chip = flops_epoch * epochs / wall if flops_epoch else None
-            return {
+            result = {
                 "images_per_sec": round(images / wall, 1),
                 "images_per_sec_per_chip": round(ips_chip, 1),
                 "epochs": epochs,
@@ -599,12 +606,11 @@ class Trainer:
                 "mfu": (lambda v: round(v, 6) if v is not None else None)(_mfu(fps_chip)),
                 "last_loss": last_loss,
                 "device": str(jax.devices()[0]),
-                **(
-                    {"tokens_per_sec_per_chip": round(
-                        ips_chip * self.train_images.shape[1], 1)}
-                    if self.train_images.ndim == 2 else {}
-                ),
             }
+            tokens = self._tokens_per_sec(ips_chip)
+            if tokens is not None:
+                result["tokens_per_sec_per_chip"] = tokens
+            return result
         finally:
             # the warm call donated self.state's buffers — restore even on
             # error so the trainer honors "training is undisturbed"
@@ -760,11 +766,9 @@ class Trainer:
             # global leaf sizes: layout-independent, valid at any dp/tp/sp
             "param_count": self.state.param_count(),
         }
-        if self.train_images.ndim == 2:  # token sequences: report tokens/sec too
-            seq_len = self.train_images.shape[1]
-            summary["tokens_per_sec_per_chip"] = round(
-                images * seq_len / steady_mean / chips, 1
-            )
+        tokens = self._tokens_per_sec(images / steady_mean / chips) if steady_mean else None
+        if tokens is not None:
+            summary["tokens_per_sec_per_chip"] = tokens
         flops_epoch = self._epoch_flops()
         if flops_epoch and steady_mean:
             from distributed_tensorflow_ibm_mnist_tpu.utils.flops import mfu as _mfu

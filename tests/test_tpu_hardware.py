@@ -68,8 +68,9 @@ def _tpu_plausible() -> bool:
     )
 
 
-@pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
-def test_pallas_kernels_on_real_tpu():
+def _run_on_tpu(worker_src: str, ok_marker: str, timeout: int = 560) -> None:
+    """Probe for an attached TPU (skip if none), run ``worker_src`` in a
+    default-env subprocess, and assert it printed ``ok_marker``."""
     probe = subprocess.run(
         [sys.executable, "-c", PROBE], capture_output=True, text=True,
         timeout=120, cwd=str(REPO), env=_default_env(),
@@ -77,11 +78,16 @@ def test_pallas_kernels_on_real_tpu():
     if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
         pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
     proc = subprocess.run(
-        [sys.executable, "-c", WORKER], capture_output=True, text=True,
-        timeout=560, cwd=str(REPO), env=_default_env(),
+        [sys.executable, "-c", worker_src], capture_output=True, text=True,
+        timeout=timeout, cwd=str(REPO), env=_default_env(),
     )
     assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
-    assert "TPU_KERNELS_OK" in proc.stdout
+    assert ok_marker in proc.stdout
+
+
+@pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
+def test_pallas_kernels_on_real_tpu():
+    _run_on_tpu(WORKER, "TPU_KERNELS_OK")
 
 
 GOLDEN = r'''
@@ -184,18 +190,7 @@ def test_gspmd_path_on_real_tpu():
     depends on (jit with NamedShardings, Megatron spec placement, ring/
     pipeline/MoE shard_map islands) compiles and executes on the real chip,
     so Mosaic/GSPMD-specific breakage can't hide behind the CPU mesh."""
-    probe = subprocess.run(
-        [sys.executable, "-c", PROBE], capture_output=True, text=True,
-        timeout=120, cwd=str(REPO), env=_default_env(),
-    )
-    if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
-        pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
-    proc = subprocess.run(
-        [sys.executable, "-c", GSPMD], capture_output=True, text=True,
-        timeout=560, cwd=str(REPO), env=_default_env(),
-    )
-    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
-    assert "GSPMD_TPU_OK" in proc.stdout
+    _run_on_tpu(GSPMD, "GSPMD_TPU_OK")
 
 
 LM_GOLDEN = r'''
@@ -224,33 +219,11 @@ print("LM_GOLDEN_OK", losses[-1], s["tokens_per_sec_per_chip"], flush=True)
 def test_causal_lm_golden_on_tpu():
     """The config-driven long-context LM (causal flash attention, 1024-token
     retrieval) learns the task on the real chip at sane token throughput."""
-    probe = subprocess.run(
-        [sys.executable, "-c", PROBE], capture_output=True, text=True,
-        timeout=120, cwd=str(REPO), env=_default_env(),
-    )
-    if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
-        pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
-    proc = subprocess.run(
-        [sys.executable, "-c", LM_GOLDEN], capture_output=True, text=True,
-        timeout=560, cwd=str(REPO), env=_default_env(),
-    )
-    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
-    assert "LM_GOLDEN_OK" in proc.stdout
+    _run_on_tpu(LM_GOLDEN, "LM_GOLDEN_OK")
 
 
 @pytest.mark.skipif(not _tpu_plausible(), reason="no TPU signals on this host")
 def test_lenet_golden_metric_on_tpu():
     """SURVEY.md §4 golden-metric job: the [B:8] LeNet config on the real
     chip must reach 99% inside the 60s north-star budget at sane throughput."""
-    probe = subprocess.run(
-        [sys.executable, "-c", PROBE], capture_output=True, text=True,
-        timeout=120, cwd=str(REPO), env=_default_env(),
-    )
-    if probe.returncode != 0 or not probe.stdout.strip().endswith("tpu"):
-        pytest.skip(f"no TPU attached: {probe.stdout.strip()[-100:]}")
-    proc = subprocess.run(
-        [sys.executable, "-c", GOLDEN], capture_output=True, text=True,
-        timeout=560, cwd=str(REPO), env=_default_env(),
-    )
-    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-2000:]
-    assert "GOLDEN_OK" in proc.stdout
+    _run_on_tpu(GOLDEN, "GOLDEN_OK")
