@@ -7,10 +7,14 @@ headline task (the MNIST CNN of SURVEY.md §2.1):
   via the supported ``Trainer.measure_throughput`` API (chained epoch
   dispatches, one readback — per-epoch readbacks would measure the
   host<->device link, not the chip);
-* wall-clock to 99% test accuracy — reported both including and excluding
-  the one-time XLA compile (the reference's TF1 session had no compile
-  stage; its per-step feed_dict overhead is precisely what this design
-  removes);
+* wall-clock to 99% test accuracy — reported excluding the one-time XLA
+  compile and including it under BOTH compile conditions (cold: persistent
+  cache bypassed; warm: persistent cache hit), each measured in this run,
+  with the cache's pre-run state recorded — so the JSON line self-describes
+  its compile provenance instead of silently depending on whether a
+  previous run warmed the cache (VERDICT.md r2 item 7).  (The reference's
+  TF1 session had no compile stage; its per-step feed_dict overhead is
+  precisely what this design removes.);
 
 plus MFU (fraction of the chip's bf16 peak, from XLA's cost analysis of the
 compiled epoch — see docs/PERFORMANCE.md for the denominator).
@@ -34,25 +38,112 @@ BASELINE_IMAGES_PER_SEC_PER_CHIP = 10_000.0  # nominal reference estimate, see d
 TARGET_ACC = 0.99
 
 
-def main() -> None:
-    import jax
+# The bench condition as CLI-visible overrides, defined ONCE so the
+# subprocess compile-measurement leg runs the exact same program shapes.
+BENCH_OVERRIDES: dict = {
+    "batch_size": 1024, "epochs": 15, "lr": 4e-3, "schedule": "cosine",
+    "target_accuracy": TARGET_ACC, "eval_every": 1, "quiet": True,
+}
 
-    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+
+def _cache_prewarmed(cache_dir: str | None) -> bool:
+    """Whether the persistent compile cache already holds entries."""
+    import os
+
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return False
+    try:
+        return any(os.scandir(cache_dir))
+    except OSError:
+        return False
+
+
+def _compile_s_in_subprocess(use_cache: bool) -> float | None:
+    """compile_and_first_epoch_s of the bench program in a FRESH process.
+
+    In-process measurement of the other compile condition is dishonest both
+    ways: jax serves persistent-cache entries from an in-process memory
+    layer, so "cache disabled" after a warm compile is not cold, and a
+    repeat compile in the same process is warmer than any fresh run.  A
+    subprocess (`launch/cli.py --throughput 1`) has no in-memory caches —
+    cold really recompiles, warm really deserializes from disk.  None if
+    the subprocess fails (the main figures don't depend on it).
+    """
+    import json
+    import subprocess
+    import sys
+
+    args = [
+        sys.executable, "-m", "distributed_tensorflow_ibm_mnist_tpu.launch.cli",
+        "--preset", "mnist_lenet_1chip", "--throughput", "1",
+    ]
+    for key, val in BENCH_OVERRIDES.items():
+        args += ["--set", f"{key}={val!r}"]
+    if not use_cache:
+        args += ["--set", "compile_cache_dir=None"]
+    try:
+        out = subprocess.run(args, capture_output=True, text=True, timeout=420)
+        for line in out.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "throughput":
+                return rec["compile_and_first_epoch_s"]
+        # fell through: no throughput record — say why on stderr (e.g. a
+        # single-client TPU runtime refusing a second process) instead of
+        # silently nulling the compile fields
+        print(
+            f"bench: compile-measurement subprocess (use_cache={use_cache}) "
+            f"produced no throughput record (rc={out.returncode}); stderr "
+            f"tail: {out.stderr[-500:]!r}",
+            file=sys.stderr,
+        )
+    except (subprocess.SubprocessError, OSError) as e:
+        print(
+            f"bench: compile-measurement subprocess (use_cache={use_cache}) "
+            f"failed: {e!r}",
+            file=sys.stderr,
+        )
+    return None
+
+
+def main() -> None:
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import (
+        Trainer,
+        resolve_compile_cache_dir,
+    )
     from distributed_tensorflow_ibm_mnist_tpu.utils.config import get_preset
 
     # batch 1024 saturates the chip (measured on v5e: ~590k img/s steady-state;
     # larger batches gain nothing — the model is overhead/bandwidth-bound, not
     # MXU-bound) while a cosine-annealed 4e-3 Adam still reaches 99% test acc
     # in 2 epochs.
-    cfg = get_preset("mnist_lenet_1chip").replace(
-        batch_size=1024, epochs=15, lr=4e-3, schedule="cosine",
-        target_accuracy=TARGET_ACC, eval_every=1, quiet=True,
-    )
+    cfg = get_preset("mnist_lenet_1chip").replace(**BENCH_OVERRIDES)
+    cache_dir = resolve_compile_cache_dir(cfg.compile_cache_dir)
+    prewarmed = _cache_prewarmed(cache_dir)
     trainer = Trainer(cfg)
 
     # Phase 1 — steady-state throughput + MFU (public API; also warms the
     # epoch-runner compile cache and restores the fresh state afterwards).
+    # This process started fresh, so its compile_and_first_epoch_s IS the
+    # honest figure for the cache condition found on disk: cold when the
+    # persistent cache started empty, warm when it was prewarmed.
     tput = trainer.measure_throughput(epochs=10)
+
+    # Phase 1b — the OTHER compile condition, measured in a fresh
+    # subprocess (see _compile_s_in_subprocess for why in-process is
+    # dishonest in both directions).
+    if prewarmed:
+        compile_s_warm = tput["compile_and_first_epoch_s"]
+        compile_s_cold = _compile_s_in_subprocess(use_cache=False)
+    else:
+        compile_s_cold = tput["compile_and_first_epoch_s"]
+        # phase 1 just populated the cache (if one resolved); a fresh
+        # process now hits it — with no cache dir a "warm" run is a myth
+        compile_s_warm = (
+            _compile_s_in_subprocess(use_cache=True) if cache_dir else None
+        )
 
     # Warm the eval compile outside phase 2's timed region (same shapes).
     trainer.evaluate()
@@ -77,11 +168,25 @@ def main() -> None:
         "time_to_target_s_excl_compile": (
             round(wall_excl_compile, 3) if summary["time_to_target_s"] else None
         ),
-        "time_to_target_s_incl_compile": (
-            round(wall_excl_compile + tput["compile_and_first_epoch_s"], 3)
-            if summary["time_to_target_s"]
+        # both compile conditions, each measured THIS run (see phase 1b);
+        # compile_cache_prewarmed records which condition phase 1 ran under
+        "time_to_target_s_incl_compile_cold": (
+            round(wall_excl_compile + compile_s_cold, 3)
+            if summary["time_to_target_s"] and compile_s_cold is not None
             else None
         ),
+        "time_to_target_s_incl_compile_warm": (
+            round(wall_excl_compile + compile_s_warm, 3)
+            if summary["time_to_target_s"] and compile_s_warm is not None
+            else None
+        ),
+        "compile_s_cold": (
+            round(compile_s_cold, 3) if compile_s_cold is not None else None
+        ),
+        "compile_s_warm": (
+            round(compile_s_warm, 3) if compile_s_warm is not None else None
+        ),
+        "compile_cache_prewarmed": prewarmed,
         "north_star_target_s": 60.0,
         "epochs_run": summary["epochs_run"],
         "throughput_epochs": tput["epochs"],

@@ -53,6 +53,12 @@ def _build(argv: list[str] | None = None) -> tuple[RunConfig, argparse.Namespace
         help="restore the latest checkpoint from checkpoint_dir before training",
     )
     parser.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture an XLA/TPU profile of the steady-state epochs into DIR "
+        "(TensorBoard profile plugin format); shorthand for "
+        "--set profile_dir=DIR",
+    )
+    parser.add_argument(
         "--throughput", type=int, default=None, metavar="EPOCHS",
         help="measure steady-state throughput/MFU over EPOCHS chained epochs "
         "(Trainer.measure_throughput) instead of training; prints one JSON line",
@@ -81,6 +87,8 @@ def _build(argv: list[str] | None = None) -> tuple[RunConfig, argparse.Namespace
     overrides = dict(args.overrides)
     if args.resume:
         overrides["resume"] = True
+    if args.profile:
+        overrides["profile_dir"] = args.profile
     unknown = set(overrides) - set(config.to_dict())
     if unknown:
         parser.error(f"unknown config fields: {sorted(unknown)}")
@@ -102,7 +110,15 @@ def main(argv: list[str] | None = None) -> int:
             ensure_virtual_cpu_devices(args.virtual_devices)
     trainer = Trainer(config)
     if args.throughput:
-        out = trainer.measure_throughput(epochs=args.throughput)
+        if config.profile_dir:
+            # profile the measurement region too (the compile epoch is
+            # unavoidably in-trace here; fit() stages it out instead)
+            from distributed_tensorflow_ibm_mnist_tpu.utils.profiling import trace
+
+            with trace(config.profile_dir):
+                out = trainer.measure_throughput(epochs=args.throughput)
+        else:
+            out = trainer.measure_throughput(epochs=args.throughput)
         print(json.dumps({"kind": "throughput", **out}), flush=True)
         return 0
     summary = trainer.fit()

@@ -11,8 +11,17 @@ Inputs are int token arrays (B, S); logits are per-position (B, S, vocab)
 and the framework's loss/accuracy/eval paths handle the extra position axis
 unchanged (per-token cross-entropy and accuracy).  Attention is causal by
 default; a trainer-supplied ``attn_fn`` (the sp ring/Ulysses island) takes
-priority, carrying its own causal flag from ``RunConfig.causal`` — set
-``causal=True`` there or the sp island will attend bidirectionally.
+priority and the Trainer DERIVES its causal flag from this family default
+(``Trainer.causal``), so ``RunConfig(model="causal_lm", sp=4)`` is causal
+without restating ``causal=True`` — pass ``model_kwargs={"causal": False}``
+to explicitly train bidirectionally.
+
+Positions are rotary by default (``pos="rope"``, models/transformer.py
+``apply_rope``): relative-position attention with no per-position
+parameters, so checkpoints don't bake in a maximum length and the model
+runs on sequences longer than it trained on — the right default for the
+long-context story the ring buys (VERDICT.md r2 item 5).  ``pos="learned"``
+keeps the (1, S, dim) table for ablation.
 
 Reuses :class:`~.transformer.TransformerBlock`, so TP (qkv/proj Megatron
 specs), MoE blocks, and block remat all apply as they do to the ViT.
@@ -42,6 +51,9 @@ class CausalLM(nn.Module):
     attn_fn: Callable | None = None  # sp island (brings its OWN causal flag)
     attn: str = "vanilla"  # 'vanilla' | 'flash' for the local kernels
     causal: bool = True
+    pos: str = "rope"  # 'rope' (rotary, default: length-extrapolating, no
+    #   per-position params) | 'learned' (the (1, S, dim) table — bakes max
+    #   length into the checkpoint; kept for ablation) | 'none'
     moe_every: int = 0
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
@@ -58,8 +70,14 @@ class CausalLM(nn.Module):
         x = nn.Embed(self.num_classes, self.dim, dtype=self.dtype, name="embed")(
             tokens.astype(jnp.int32)
         )
-        pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, s, self.dim))
-        x = x + pos.astype(self.dtype)
+        if self.pos == "learned":
+            pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, s, self.dim))
+            x = x + pos.astype(self.dtype)
+        elif self.pos not in ("rope", "none"):
+            raise ValueError(
+                f"unknown pos {self.pos!r}; use 'rope', 'learned' or 'none'"
+            )
+        rope = self.pos == "rope"  # applied to q/k inside each block
         attn_fn = self.attn_fn
         if attn_fn is None:
             if self.attn == "flash":
@@ -88,7 +106,8 @@ class CausalLM(nn.Module):
                 dim=self.dim, heads=self.heads, n_stages=self.pp_stages,
                 per_stage=self.depth // self.pp_stages, mlp_ratio=self.mlp_ratio,
                 attn_fn=attn_fn, pipeline_fn=self.pipeline_fn,
-                block_remat=self.block_remat, dtype=self.dtype, name="pipe_blocks",
+                block_remat=self.block_remat, rope=rope, dtype=self.dtype,
+                name="pipe_blocks",
             )(x, train=train)
             x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
             x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
@@ -104,7 +123,7 @@ class CausalLM(nn.Module):
                 dropout=self.dropout, attn_fn=attn_fn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
-                moe_fn=self.moe_fn, dtype=self.dtype, name=f"block_{i}",
+                moe_fn=self.moe_fn, rope=rope, dtype=self.dtype, name=f"block_{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

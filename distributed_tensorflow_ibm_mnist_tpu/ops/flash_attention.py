@@ -8,17 +8,36 @@ VMEM while online-softmax (fwd) / recompute (bwd) accumulators live in
 VMEM scratch across the innermost grid steps.  The (S x S) score matrix
 never exists in HBM and VMEM stays O(tile), so sequence length scales to
 HBM capacity (vs the O(S) VMEM of a whole-row design that tops out around
-S~4k on v5e).  Matmuls hit the MXU in f32 accumulation regardless of
-input dtype.  The backward pass is the standard flash recompute scheme:
+S~4k on v5e).  The backward pass is the standard flash recompute scheme:
 probabilities are rebuilt blockwise from the saved row logsumexp, one
 kernel accumulating dK/dV over q-tiles and one accumulating dQ over
 k-tiles.
 
-Layout is (B, S, H, D) like the rest of the framework; head_dim is padded
-to the 128-lane TPU tile (cheap for the small heads of this model zoo, free
-for D >= 128).  Sequence padding is masked inside the kernels, so any S
-works.  On non-TPU backends the kernels run in Pallas interpret mode, which
-is how the CPU test suite exercises the same code path (SURVEY.md §4).
+MXU dtype policy (the round-3 rewrite; VERDICT.md r2 item 1): every
+matmul runs with the INPUT dtype on the MXU and float32 accumulation
+(``preferred_element_type``).  bf16 inputs therefore stream through the
+MXU at the bf16 rate — the round-2 kernel upcast everything to f32 first,
+which runs the MXU at a fraction of peak and was the dominant cost
+(measured on v5e, B=4 S=8192 H=8 D=64 causal: 225 ms fwd+bwd in f32-matmul
+form vs ~3x faster with native-dtype matmuls).  Softmax statistics, the
+probability matrix, and all scratch accumulators stay f32; probabilities
+and d(scores) are cast back to the input dtype only as MXU operands.
+f32 inputs keep full-f32 matmuls, so the CPU test suite's tight
+tolerances vs the dense reference are unchanged.
+
+Layout is (B, S, H, D) like the rest of the framework; head_dim is taken
+UNPADDED into the block shapes (Mosaic handles sub-128 minor dims in
+registers).  The round-2 kernel zero-padded D to the 128-lane tile in HBM,
+which doubled (D=64) or quadrupled (D=32) the DMA traffic and VMEM
+footprint of every block on the zoo's own head sizes; the MXU's physical
+128-lane contraction can't be filled by a D=64 per-head contraction from
+SEPARATE heads (any lane- or sublane-packing of two heads' Q/K either sums
+their score matrices or multiplies against structural zeros — same MXU
+occupancy, more memory traffic), so the fix is to stop paying for the pad
+in memory and bandwidth rather than to fake a fuller contraction.
+Sequence padding is masked inside the kernels, so any S works.  On
+non-TPU backends the kernels run in Pallas interpret mode, which is how
+the CPU test suite exercises the same code path (SURVEY.md §4).
 
 Composes with sequence parallelism: ring attention
 (parallel/ring_attention.py) rotates K/V shards BETWEEN devices while this
@@ -36,18 +55,35 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
+# Default VMEM tile sizes (q rows x k cols per inner step).  Swept on the
+# v5e at B=4 S=8192 H=8 D=64 causal bf16 (scripts/bench_flash.py): larger
+# tiles amortize the scratch read-modify-write of the online-softmax state
+# and per-step DMA setup — fwd+bwd walks 251 ms (128x128) -> 91.6
+# (256x512) -> 62.5 (512x1024), then plateaus (1024x1024: 68.0, 512x2048:
+# 69.5; the f32 softmax VPU work is the bottleneck once tiles are this
+# big).  512x1024 keeps the (Bq x Bk) f32 score tile at 2 MB, comfortably
+# inside the 16 MB scoped-VMEM budget with double-buffered operands.
+_BLOCK_Q = 512
+_BLOCK_K = 1024
+
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _pick_block(n: int, target: int = 128) -> int:
+def _pick_block(n: int, target: int) -> int:
     """Largest power-of-two tile <= target dividing n (after padding, n is
     a multiple of 8, so this always lands on >= 8... or n itself if tiny)."""
-    for b in (target, 64, 32, 16, 8):
-        if n % b == 0:
-            return b
-    return n
+    b = 8
+    while b * 2 <= target and n % (b * 2) == 0:
+        b *= 2
+    return b if n % b == 0 else n
+
+
+def _dot(a, b, dims):
+    """MXU matmul in the operands' dtype with f32 accumulation."""
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
@@ -63,11 +99,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
-        k = k_ref[0].astype(jnp.float32)             # (Bk, D)
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]  # (Bq, D), input dtype
+        k = k_ref[0]  # (Bk, D)
+        v = v_ref[0]
         tq, bk = q.shape[0], k.shape[0]
-        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        scores = _dot(q, k, (((1,), (1,)))) * sm_scale  # (Bq, Bk) f32
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
         mask = k_pos < s_real
@@ -81,7 +117,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
         corr = jnp.exp(m_prev - m_new)
         m_sc[...] = m_new
         l_sc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_sc[...] = acc_prev * corr + jax.lax.dot(p, v)
+        acc_sc[...] = acc_prev * corr + _dot(p.astype(v.dtype), v, ((1,), (0,)))
 
     # NOTE: gating dead above-diagonal causal tiles with pl.when was measured
     # on v5e and does NOT help: block DMA is issued by the BlockSpec pipeline
@@ -108,25 +144,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
     def _compute():
-        k = k_ref[0].astype(jnp.float32)   # (Bk, D)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]   # (Bk, D), input dtype
+        v = v_ref[0]
+        q = q_ref[0]   # (Bq, D)
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         bq, bk = q.shape[0], k.shape[0]
-        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (Bq, Bk)
+        scores = _dot(q, k, ((1,), (1,))) * sm_scale  # (Bq, Bk) f32
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = (k_pos < s_real) & (q_pos < s_real)
         if causal:
             mask = mask & (k_pos <= q_pos)
-        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed probs
-        dv_sc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (Bq, Bk)
-        ds = p * (dp - delta)
-        # with the scale folded into q, dK = dS^T @ q_folded directly
-        dk_sc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed probs, f32
+        dv_sc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        dp = _dot(do, v, ((1,), (1,)))  # (Bq, Bk) f32
+        ds = p * (dp - delta) * sm_scale
+        dk_sc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
     _compute()  # see causal-gating NOTE in _fwd_kernel
 
@@ -146,23 +181,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]  # (Bq, D), input dtype
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
         tq, bk = q.shape[0], k.shape[0]
-        scores = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        scores = _dot(q, k, ((1,), (1,))) * sm_scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
         mask = k_pos < s_real
         if causal:
             mask = mask & (k_pos <= q_pos)
         p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * sm_scale
-        dq_sc[...] += jax.lax.dot(ds, k)
+        dq_sc[...] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     _compute()  # see causal-gating NOTE in _fwd_kernel
 
@@ -171,19 +206,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
         dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _pad(x, s_pad, d_pad):
-    return jnp.pad(x, ((0, 0), (0, s_pad), (0, d_pad)))
-
-
 def _prepare(q, k, v):
-    """(B, S, H, D) -> (B*H, S_pad, D_pad) plus the static real sizes."""
+    """(B, S, H, D) -> (B*H, S_pad, D) plus the static real sizes.
+
+    Only the sequence is padded (to the 8-sublane tile); head_dim rides
+    through unpadded — see the module docstring for why lane-padding D is
+    pure waste."""
     b, s, h, d = q.shape
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     q, k, v = to_bh(q), to_bh(k), to_bh(v)
     s_pad = (-s) % 8
-    d_pad = (-d) % 128
-    if s_pad or d_pad:
-        q, k, v = (_pad(x, s_pad, d_pad) for x in (q, k, v))
+    if s_pad:
+        q, k, v = (jnp.pad(x, ((0, 0), (0, s_pad), (0, 0))) for x in (q, k, v))
     return q, k, v, (b, s, h, d)
 
 
@@ -208,9 +242,9 @@ def _flash_fwd(q, k, v, causal, interpret):
     if interpret is None:
         interpret = not _on_tpu()
     qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
-    bh, sp, dp_ = qp.shape
-    block_q = _pick_block(sp)
-    block_k = _pick_block(sp)
+    bh, sp, _ = qp.shape
+    block_q = _pick_block(sp, _BLOCK_Q)
+    block_k = _pick_block(sp, _BLOCK_K)
     n_k = sp // block_k
     sm_scale = d**-0.5
     kernel = partial(
@@ -221,26 +255,26 @@ def _flash_fwd(q, k, v, causal, interpret):
         kernel,
         grid=(bh, sp // block_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
+            jax.ShapeDtypeStruct((bh, sp, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),    # m
-            pltpu.VMEM((block_q, 1), jnp.float32),    # l
-            pltpu.VMEM((block_q, dp_), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),  # m
+            pltpu.VMEM((block_q, 1), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
         ],
         **_grid_params(interpret),
     )(qp, kp, vp)
-    out_bshd = out[:, :s, :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out_bshd = out[:, :s, :].reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out_bshd, (q, k, v, out_bshd, lse)
 
 
@@ -263,9 +297,9 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
         interpret = not _on_tpu()
     qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
     gp = _prepare(g, g, g)[0]
-    bh, sp, dp_ = qp.shape
-    block_q = _pick_block(sp)
-    block_k = _pick_block(sp)
+    bh, sp, _ = qp.shape
+    block_q = _pick_block(sp, _BLOCK_Q)
+    block_k = _pick_block(sp, _BLOCK_K)
     n_q = sp // block_q
     n_k = sp // block_k
     sm_scale = d**-0.5
@@ -275,24 +309,24 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
                 n_q=n_q, s_real=s, causal=causal),
         grid=(bh, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, j, i: (b_, i, 0)),   # q tile
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),   # k tile
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),   # v tile
-            pl.BlockSpec((1, block_q, dp_), lambda b_, j, i: (b_, i, 0)),   # do tile
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),     # lse
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),     # delta
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),   # q tile
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),   # k tile
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),   # v tile
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),   # do tile
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),   # lse
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),   # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp_), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
-            jax.ShapeDtypeStruct((bh, sp, dp_), v.dtype),
+            jax.ShapeDtypeStruct((bh, sp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sp, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, dp_), jnp.float32),  # dk
-            pltpu.VMEM((block_k, dp_), jnp.float32),  # dv
+            pltpu.VMEM((block_k, d), jnp.float32),  # dk
+            pltpu.VMEM((block_k, d), jnp.float32),  # dv
         ],
         **_grid_params(interpret),
     )(qp, kp, vp, gp, lse, delta)
@@ -303,21 +337,21 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
                 n_k=n_k, s_real=s, causal=causal),
         grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp_), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp_), lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sp, dp_), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, dp_), jnp.float32)],  # dq
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],  # dq
         **_grid_params(interpret),
     )(qp, kp, vp, gp, lse, delta)
 
     def from_bh(x):
-        return x[:, :s, :d].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        return x[:, :s, :].reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
     return from_bh(dq_p), from_bh(dk_p), from_bh(dv_p)
 

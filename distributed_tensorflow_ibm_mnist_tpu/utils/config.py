@@ -87,6 +87,10 @@ class RunConfig:
     resume: bool = False  # restore latest checkpoint from checkpoint_dir before training
     metrics_path: str | None = None  # JSONL file (always also stdout unless quiet)
     quiet: bool = False  # suppress stdout metric lines (tests/benchmarks)
+    profile_dir: str | None = None  # capture an XLA/TPU profile of the
+    #   steady-state epochs of fit() into this dir (TensorBoard profile
+    #   plugin format; utils/profiling).  The first epoch — XLA compile —
+    #   is fenced out of the trace when epochs > 1.  CLI: --profile DIR.
     # Persistent XLA compilation cache: repeat runs skip the one-time compile
     # (the analog of the reference having no compile stage at all). None
     # disables; "default" resolves to $DTM_COMPILE_CACHE if set, else

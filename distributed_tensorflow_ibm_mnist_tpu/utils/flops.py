@@ -13,10 +13,12 @@ the single-device count), which is exactly the numerator MFU needs.
 Two caveats, both verified on this backend: (1) a while-loop body is
 counted ONCE regardless of trip count — callers must scale by their scan
 trips (Trainer._epoch_flops does); (2) custom calls — Pallas kernels —
-report no FLOPs (the sentinel -2), so for models running flash attention
-the reported MFU is a LOWER bound that excludes the attention FLOPs
-entirely; throughput (images- or tokens-per-sec) is the cross-model
-comparable number there.
+report no FLOPs (the sentinel -2), so a flash-attention model's cost
+analysis is missing exactly the attention matmuls.  For its own flash
+configs the Trainer closes that hole with :func:`attention_flops` — the
+standard analytic model-FLOPs count — so reported MFU is real, not a
+lower bound (VERDICT.md r2 item 2).  Models driving OTHER custom calls
+through ``attn_fn`` remain lower bounds.
 
 MFU denominator: the chip's peak matmul throughput at the dtype the model
 computes in (bf16 for the zoo's default).  Peaks are keyed on
@@ -35,7 +37,8 @@ import jax
 _PEAK_TFLOPS_BF16: dict[str, float] = {
     "TPU v2": 22.5,
     "TPU v3": 61.5,  # a.k.a. 123 per dual-core board
-    "TPU v4": 137.5,  # 275 per 2-die chip; device_kind is per chip -> 275
+    "TPU v4i": 137.5,  # single-die inference chip — NOT a v4 variant
+    "TPU v4": 275.0,  # 2-die training chip; device_kind names the chip
     "TPU v5 lite": 197.0,
     "TPU v5e": 197.0,
     "TPU v5": 229.5,
@@ -50,9 +53,12 @@ def device_peak_tflops(device=None) -> float | None:
     """Peak bf16 TFLOP/s for ``device`` (default: first visible device).
 
     Longest-prefix match on ``device_kind`` so variants like
-    "TPU v5 lite podslice" resolve; ``$DTM_PEAK_TFLOPS`` wins outright.
-    Returns None when unknown (CPU, exotic kinds) — callers report MFU as
-    None rather than against a made-up peak.
+    "TPU v5 lite podslice" resolve consistently with their base kind
+    ("TPU v4 ..." suffixed variants land on the same 275 as the exact
+    kind; "TPU v4i" is its own, longer, entry and wins its own prefix);
+    ``$DTM_PEAK_TFLOPS`` wins outright.  Returns None when unknown (CPU,
+    exotic kinds) — callers report MFU as None rather than against a
+    made-up peak.
     """
     env = os.environ.get("DTM_PEAK_TFLOPS")
     if env:
@@ -62,13 +68,35 @@ def device_peak_tflops(device=None) -> float | None:
             pass
     device = device or jax.devices()[0]
     kind = str(getattr(device, "device_kind", "")).strip()
-    if kind == "TPU v4":
-        return 275.0  # device_kind names the 2-die chip, not the die
     best = None
     for prefix, peak in _PEAK_TFLOPS_BF16.items():
         if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
             best = (len(prefix), peak)
     return best[1] if best else None
+
+
+def attention_flops(
+    batch: int, seq: int, heads: int, head_dim: int, *,
+    causal: bool = False, with_backward: bool = True, depth: int = 1,
+) -> float:
+    """Analytic matmul FLOPs of multi-head attention, standard model-FLOPs
+    convention: forward is the QK^T and PV matmuls (4*B*S^2*H*D), backward
+    counted at 2x forward, causal attention halved.
+
+    This is the MFU-numerator convention of the scaling literature — the
+    FLOPs the computation semantically NEEDS.  The flash kernels execute
+    more (the bwd recompute adds ~2 extra score matmuls, and causal tiles
+    are not skipped — a measured rejection, see ops/flash_attention.py), so
+    an MFU built on this count is conservative w.r.t. what the MXU actually
+    ran, matching how the dense path's XLA cost analysis treats it
+    (validated against each other in tests/test_flops.py).
+    """
+    f = 4.0 * batch * seq * seq * heads * head_dim * depth
+    if with_backward:
+        f *= 3.0
+    if causal:
+        f /= 2.0
+    return f
 
 
 def compiled_flops(jitted_fn, *args) -> float | None:

@@ -37,6 +37,33 @@ def start_server(port: int = 9999):
     return jax.profiler.start_server(port)
 
 
+class TraceSession:
+    """Imperatively-staged profile capture for loops that decide mid-flight
+    where steady state begins.
+
+    ``Trainer.fit`` (RunConfig.profile_dir / ``--profile``) starts the
+    capture after the first epoch's fence — so the one-time XLA compile
+    doesn't bury the steady-state timeline — and stops it after the last
+    fetch.  :func:`trace` stays the one-shot context-manager form of the
+    same thing.  ``stop`` is idempotent and safe to call without ``start``
+    (error-path friendly).
+    """
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self.active = False
+
+    def start(self) -> None:
+        if not self.active:
+            jax.profiler.start_trace(self.log_dir, create_perfetto_link=False)
+            self.active = True
+
+    def stop(self) -> None:
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+
+
 class StepTimer:
     """Wall-time per step with device fencing and warmup exclusion.
 

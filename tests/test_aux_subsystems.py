@@ -42,6 +42,32 @@ def test_step_timer_and_profile_fn():
     assert s["items_per_sec"] > 0 and len(timer.times) == 3
 
 
+def test_profile_dir_captures_fit_trace(tmp_path):
+    """RunConfig.profile_dir (VERDICT.md r2 item 4): fit() writes a
+    TensorBoard-profile capture of the steady-state epochs."""
+    import os
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+
+    prof_dir = str(tmp_path / "prof")
+    t = Trainer(_cfg(profile_dir=prof_dir, epochs=3, eval_every=3))
+    t.fit()
+    hits = []
+    for root, _dirs, files in os.walk(prof_dir):
+        hits += [os.path.join(root, f) for f in files if ".xplane." in f or f.endswith(".trace.json.gz")]
+    assert hits, f"no profile artifacts under {prof_dir}"
+
+
+def test_cli_profile_flag(tmp_path):
+    from distributed_tensorflow_ibm_mnist_tpu.launch.cli import build_config
+
+    cfg = build_config(["--profile", str(tmp_path / "p")])
+    assert cfg.profile_dir == str(tmp_path / "p")
+    # --set spelling reaches the same field
+    cfg2 = build_config(["--set", f"profile_dir={tmp_path / 'q'}"])
+    assert cfg2.profile_dir == str(tmp_path / "q")
+
+
 # ---- debug / divergence detection ----
 
 def test_all_finite_and_find_nonfinite():

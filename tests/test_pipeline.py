@@ -206,3 +206,38 @@ def test_pp_with_block_remat(eight_devices):
     a, b = jax.device_get((t1.state.params, t2.state.params))
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-4)
+
+
+def test_pp_x_tp_narrowing_warns_and_shards_as_documented(eight_devices):
+    """pp x tp honest-composition contract (VERDICT.md r2 item 8): the
+    Trainer warns that Megatron sharding reaches only NON-pipelined leaves;
+    stacked-block leaves carry 'pipe' (never 'model'), while the head is
+    genuinely 'model'-sharded."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="pptp", model="vit",
+        model_kwargs={"patch_size": 7, "dim": 16, "depth": 2, "heads": 2,
+                      "dtype": jnp.float32},
+        dataset="mnist", synthetic=True, n_train=256, n_test=64,
+        batch_size=32, epochs=1, quiet=True, eval_batch_size=32,
+        dp=2, tp=2, pp=2,
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        t = Trainer(cfg)
+    assert any("NOT tensor-parallel" in str(x.message) for x in w), [
+        str(x.message) for x in w
+    ]
+    for leaf in jax.tree.leaves(t.state.params["pipe_blocks"]["stacked"]):
+        dims = tuple(leaf.sharding.spec)
+        assert dims and dims[0] == "pipe" and "model" not in dims
+    logits_spec = tuple(t.state.params["logits"]["kernel"].sharding.spec)
+    assert "model" in logits_spec  # the non-pipelined head IS Megatron-sharded
+    s = t.fit()
+    assert np.isfinite(s["best_test_accuracy"])
