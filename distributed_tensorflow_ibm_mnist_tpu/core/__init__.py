@@ -9,6 +9,7 @@ XLA module, eliminating the reference's per-step host->device feed and
 per-step variable RPCs (SURVEY.md §3.1 "hot-loop pathologies").
 """
 
+from distributed_tensorflow_ibm_mnist_tpu.core.generate import generate, make_generator
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
 from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
     make_epoch_runner,
@@ -16,7 +17,7 @@ from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
     make_train_step,
 )
 
-__all__ = ["TrainState", "make_train_step", "make_eval_fn", "make_epoch_runner", "Trainer"]
+__all__ = ["TrainState", "make_train_step", "make_eval_fn", "make_epoch_runner", "Trainer", "make_generator", "generate"]
 
 
 def __getattr__(name):

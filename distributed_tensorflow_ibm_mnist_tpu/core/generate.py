@@ -1,0 +1,109 @@
+"""Autoregressive generation for the causal LM family (KV-cache decode).
+
+The reference repo was a trainer only (SURVEY.md §2.1 — no inference
+surface), but a language-model family without a decode path is half a
+framework: this module turns a trained :class:`~..models.causal_lm.CausalLM`
+into a text generator the TPU way — the whole generation is ONE compiled
+program (prefill + a ``lax.scan`` over decode steps), not a Python loop of
+device round-trips, so the tunnel/host latency that dominates naive
+decode loops is paid once per call.
+
+Mechanics: TransformerBlock's decode mode (models/transformer.py
+``_decode_attention``) keeps per-block K/V caches in a flax ``cache``
+variable collection, appended via ``dynamic_update_slice`` at a running
+``cache_index``; RoPE rotates each chunk at its absolute position, which
+is why ``pos="rope"`` (the family default) is required — a learned
+position table cannot address positions incrementally, let alone beyond
+its trained length.
+
+    gen = make_generator(model, max_len=256, max_new=64)
+    tokens = gen(params, prompt)                 # greedy
+    tokens = gen(params, prompt, rng=key)        # sampled if temperature>0
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def make_generator(
+    model,
+    max_len: int,
+    max_new: int,
+    temperature: float = 0.0,
+) -> Callable:
+    """Build a jitted ``gen(params, prompt, rng=None) -> (B, P+max_new)``.
+
+    ``prompt`` is int tokens (B, P) with P + max_new <= max_len (the KV
+    cache size, static).  ``temperature == 0`` decodes greedily (argmax);
+    otherwise logits/temperature are sampled categorically with ``rng``.
+    The returned callable is compiled once per (prompt length, batch)
+    shape; reuse it across calls.
+    """
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
+
+    def pick(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
+
+    @functools.partial(jax.jit, static_argnames=())
+    def gen(params, prompt, rng=None):
+        b, p = prompt.shape
+        if p + max_new > max_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new ({max_new}) exceeds max_len ({max_len})"
+            )
+        if rng is None:
+            if temperature != 0.0:
+                raise ValueError(
+                    "temperature > 0 samples from the model — pass rng= "
+                    "(repeated calls would otherwise all return the "
+                    "PRNGKey(0) sample)"
+                )
+            rng = jax.random.PRNGKey(0)  # greedy: rngs are split but unused
+        # Prefill: one decode-mode pass over the whole prompt populates
+        # every block's KV cache and yields the next-token logits.
+        logits, vars_ = model.apply(
+            {"params": params}, prompt, decode=True, max_len=max_len,
+            mutable=["cache"],
+        )
+        cache = vars_["cache"]
+        rng, r0 = jax.random.split(rng)
+        first = pick(logits[:, -1], r0)
+
+        def body(carry, step_rng):
+            cache, tok = carry
+            logits, vars_ = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                decode=True, max_len=max_len, mutable=["cache"],
+            )
+            nxt = pick(logits[:, 0], step_rng)
+            return (vars_["cache"], nxt), nxt
+
+        (_, _), rest = jax.lax.scan(
+            body, (cache, first), jax.random.split(rng, max_new - 1)
+        )
+        new_tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
+        return jnp.concatenate([prompt.astype(jnp.int32), new_tokens], axis=1)
+
+    return gen
+
+
+def generate(model, params, prompt, max_new: int, max_len: int | None = None,
+             temperature: float = 0.0, rng=None):
+    """One-shot convenience over :func:`make_generator` (compiles per call —
+    build the generator once for repeated use)."""
+    prompt = jnp.asarray(prompt)
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    if max_len is None:
+        max_len = int(prompt.shape[1]) + max_new
+    return make_generator(model, max_len, max_new, temperature)(
+        params, prompt, rng=rng
+    )

@@ -784,6 +784,27 @@ class Trainer:
             # plain assignment restores it with zero transfers.
             self.state = state0
 
+    def generate(self, prompt, max_new: int, max_len: int | None = None,
+                 temperature: float = 0.0, rng=None):
+        """Autoregressive decode from this run's trained weights
+        (core/generate.py; causal-LM family only).
+
+        The KV-cache decode path is single-device: params are pulled out of
+        the run's (possibly sharded) layout once per call — fine for the
+        zoo's model sizes; build :func:`~..core.generate.make_generator`
+        yourself around appropriately-placed params for repeated serving.
+        """
+        if not model_accepts(self.config.model, "pos"):
+            raise ValueError(
+                f"generate() needs a causal-LM-family model; got "
+                f"{self.config.model!r}"
+            )
+        from distributed_tensorflow_ibm_mnist_tpu.core.generate import generate
+
+        params = jax.device_put(jax.device_get(self.state.params))
+        return generate(self.model, params, prompt, max_new,
+                        max_len=max_len, temperature=temperature, rng=rng)
+
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))
         return {k: float(v) for k, v in out.items()}

@@ -65,8 +65,18 @@ class CausalLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, decode: bool = False,
+                 max_len: int = 0):
         b, s = tokens.shape
+        if decode and self.pos == "learned":
+            raise ValueError(
+                "decode mode needs position-free params: pos='learned' bakes "
+                "the trained length into a (1, S, dim) table that cannot "
+                "address incremental positions — use pos='rope' (the default)"
+            )
+        if decode and (self.pp_stages > 0 or self.moe_every > 0):
+            raise ValueError("decode mode supports the plain block stack "
+                             "(no pp_stages, no MoE)")
         x = nn.Embed(self.num_classes, self.dim, dtype=self.dtype, name="embed")(
             tokens.astype(jnp.int32)
         )
@@ -114,9 +124,13 @@ class CausalLM(nn.Module):
             return x.astype(jnp.float32)
         block_cls = (
             nn.remat(TransformerBlock, static_argnums=(2,))
-            if self.block_remat
-            else TransformerBlock
+            if self.block_remat and not decode  # remat is a backward-pass
+            else TransformerBlock               # lever; decode has no bwd
         )
+        # decode/max_len ride as kwargs only when decoding so the training
+        # trace (incl. the remat-wrapped class, whose static_argnums cover
+        # positional train only) is byte-identical to previous rounds
+        extra = {"decode": True, "max_len": max_len} if decode else {}
         for i in range(self.depth):
             x = block_cls(
                 dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
@@ -124,7 +138,7 @@ class CausalLM(nn.Module):
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
                 moe_fn=self.moe_fn, rope=rope, dtype=self.dtype, name=f"block_{i}",
-            )(x, train)
+            )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
         return x.astype(jnp.float32)

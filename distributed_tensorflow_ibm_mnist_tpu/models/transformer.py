@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
 
 
-def apply_rope(x: jnp.ndarray, theta: float = 10000.0) -> jnp.ndarray:
+def apply_rope(x: jnp.ndarray, theta: float = 10000.0, offset=0) -> jnp.ndarray:
     """Rotary position embedding on (B, S, H, D) queries/keys (D even).
 
     Pairs dimension d with d + D/2 and rotates each pair by pos * theta^(-2d/D),
@@ -41,13 +41,18 @@ def apply_rope(x: jnp.ndarray, theta: float = 10000.0) -> jnp.ndarray:
     parallelism this runs in GSPMD-jitted model code BEFORE the sp island,
     so each shard's positions come from its global iota slice and the
     rotation composes with ring/Ulysses unchanged.
+
+    ``offset`` shifts the positions (may be a traced int32 scalar): the
+    KV-cache decode path rotates the current chunk at its absolute
+    position ``cache_index + arange(s)``.
     """
     b, s, h, d = x.shape
     if d % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {d}")
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]  # (S, half)
+    pos = jnp.asarray(offset, jnp.float32) + jnp.arange(s, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]  # (S, half)
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
@@ -88,7 +93,8 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 max_len: int = 0):
         b, s, _ = x.shape
         head_dim = self.dim // self.heads
 
@@ -96,15 +102,21 @@ class TransformerBlock(nn.Module):
         qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
         qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.rope:
-            q, k = apply_rope(q), apply_rope(k)
-        o = _resolve_attn(self.attn_fn, self.attn)(q, k, v).reshape(b, s, self.dim)
+        if decode:
+            o = self._decode_attention(q, k, v, max_len)
+        else:
+            if self.rope:
+                q, k = apply_rope(q), apply_rope(k)
+            o = _resolve_attn(self.attn_fn, self.attn)(q, k, v)
+        o = o.reshape(b, s, self.dim)
         o = nn.Dense(self.dim, dtype=self.dtype, name="proj")(o)
         if self.dropout > 0.0:
             o = nn.Dropout(self.dropout, deterministic=not train)(o)
         x = x + o
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_mlp")(x)
+        if decode and self.use_moe:
+            raise ValueError("decode mode does not support MoE blocks")
         if self.use_moe:
             from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import MoEBlock
 
@@ -119,6 +131,51 @@ class TransformerBlock(nn.Module):
         if self.dropout > 0.0:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
+
+    def _decode_attention(self, q, k, v, max_len: int):
+        """Incremental (KV-cache) attention for autoregressive decoding.
+
+        Appends this call's K/V at the running ``cache_index`` (a flax
+        ``cache`` variable collection, mutated via ``mutable=["cache"]``)
+        and attends each query causally over the filled prefix.  Handles
+        S >= 1, so one call prefills the whole prompt and subsequent S=1
+        calls decode — the core/generate.py contract.  The sp/ring
+        ``attn_fn`` islands and the flash kernel are training/prefill
+        machinery; decode is bandwidth-bound gather-attend over the cache,
+        which XLA handles directly (no custom kernel needed at this scale).
+        RoPE rotates at absolute positions ``cache_index + arange(S)``.
+        """
+        if max_len <= 0:
+            raise ValueError("decode=True needs max_len > 0 (the KV-cache size)")
+        b, s, h, d = q.shape
+        cache_k = self.variable(
+            "cache", "k", lambda: jnp.zeros((b, max_len, h, d), self.dtype))
+        cache_v = self.variable(
+            "cache", "v", lambda: jnp.zeros((b, max_len, h, d), self.dtype))
+        idx_var = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32))
+        idx = idx_var.value
+        if self.rope:
+            q = apply_rope(q, offset=idx)
+            k = apply_rope(k, offset=idx)
+        import jax
+
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(cache_k.value.dtype), (0, idx, 0, 0))
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(cache_v.value.dtype), (0, idx, 0, 0))
+        idx_var.value = idx + s
+
+        q32 = q.astype(jnp.float32) * (d ** -0.5)
+        k32 = cache_k.value.astype(jnp.float32)
+        v32 = cache_v.value.astype(jnp.float32)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
+        k_pos = jnp.arange(max_len)
+        q_pos = idx + jnp.arange(s)
+        mask = k_pos[None, :] <= q_pos[:, None]  # (S, max_len), causal prefix
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v32)
+        return out.astype(self.dtype)
 
 
 class StackedBlocks(nn.Module):

@@ -1,0 +1,132 @@
+"""KV-cache decode + generate() for the causal LM family (core/generate.py).
+
+The decisive correctness property is teacher-forcing equivalence: the
+incremental decode path (cache appends + causal-prefix attention + RoPE at
+absolute offsets) must reproduce the full-forward logits position for
+position.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.core.generate import generate, make_generator
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+
+KW = dict(num_classes=16, dim=64, depth=2, heads=4, dtype=jnp.float32)
+
+
+def _model_and_params(seed=0, **over):
+    model = get_model("causal_lm", **{**KW, **over})
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return model, params
+
+
+def test_decode_matches_full_forward_teacher_forcing():
+    """Prefill 8 tokens then feed the TRUE next tokens one at a time; every
+    incremental logit must equal the full forward pass at that position."""
+    model, params = _model_and_params()
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 16, size=(2, 16)), jnp.int32)
+    full = model.apply({"params": params}, tokens)  # (2, 16, 16)
+
+    max_len = 16
+    logits, vars_ = model.apply(
+        {"params": params}, tokens[:, :8], decode=True, max_len=max_len,
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :8]), atol=2e-4
+    )
+    cache = vars_["cache"]
+    for t in range(8, 16):
+        step_logits, vars_ = model.apply(
+            {"params": params, "cache": cache}, tokens[:, t : t + 1],
+            decode=True, max_len=max_len, mutable=["cache"],
+        )
+        cache = vars_["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full[:, t]), atol=2e-4,
+            err_msg=f"position {t}",
+        )
+
+
+def test_generator_greedy_deterministic_and_shaped():
+    model, params = _model_and_params(seed=1)
+    gen = make_generator(model, max_len=32, max_new=8)
+    prompt = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+    out1 = gen(params, prompt)
+    out2 = gen(params, prompt)
+    assert out1.shape == (2, 12)
+    assert out1.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(prompt))
+    assert int(jnp.max(out1)) < 16 and int(jnp.min(out1)) >= 0
+
+
+def test_generator_greedy_matches_stepwise_argmax():
+    """The scan'd generator equals a hand-rolled argmax loop over the full
+    (cache-free) forward — greedy decode is teacher forcing on itself."""
+    model, params = _model_and_params(seed=2)
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    out = generate(model, params, prompt, max_new=6)
+    seq = prompt
+    for _ in range(6):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_sampled_generation_uses_rng():
+    model, params = _model_and_params(seed=3)
+    gen = make_generator(model, max_len=24, max_new=8, temperature=1.0)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    a = gen(params, prompt, rng=jax.random.PRNGKey(0))
+    b = gen(params, prompt, rng=jax.random.PRNGKey(0))
+    c = gen(params, prompt, rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))  # with high prob
+    with pytest.raises(ValueError, match="rng"):
+        gen(params, prompt)  # sampling without an rng is a footgun, refused
+
+
+def test_decode_past_trained_length_with_rope():
+    """Generation runs past the training sequence length (the RoPE payoff;
+    VERDICT.md r2 item 5's 'longer-than-trained smoke' for decode)."""
+    model, params = _model_and_params(seed=4)  # "trained" shapes: S=8 init
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    out = generate(model, params, prompt, max_new=24)  # decodes to S=32
+    assert out.shape == (1, 32)
+
+
+def test_learned_pos_refuses_decode():
+    model, params = _model_and_params(seed=5, pos="learned")
+    with pytest.raises(ValueError, match="rope"):
+        model.apply({"params": params}, jnp.zeros((1, 4), jnp.int32),
+                    decode=True, max_len=16, mutable=["cache"])
+
+
+def test_trainer_generate_end_to_end():
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="gen", model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 1, "heads": 4, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=256, n_test=32, batch_size=64, epochs=2, quiet=True,
+        eval_batch_size=32,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    out = t.generate(jnp.asarray([[2, 9, 4, 7]], jnp.int32), max_new=8)
+    assert out.shape == (1, 12)
+    assert out.dtype == jnp.int32
+    with pytest.raises(ValueError, match="causal-LM"):
+        Trainer(RunConfig(model="mlp", synthetic=True, n_train=64, n_test=32,
+                          batch_size=32, epochs=1, quiet=True)).generate(
+            jnp.zeros((1, 4), jnp.int32), max_new=2)
