@@ -506,10 +506,14 @@ class Trainer:
             heads = int(model_kwargs.get(
                 "heads", model_default(cfg.model, "heads", 0)
             ))
-            if heads % self.sp:
+            heads_kv = int(model_kwargs.get(
+                "heads_kv", model_default(cfg.model, "heads_kv", 0) or 0
+            )) or heads
+            if heads % self.sp or heads_kv % self.sp:
                 raise ValueError(
                     f"sp_impl='ulysses' re-shards heads over the seq axis and "
-                    f"needs heads % sp == 0; got heads={heads}, sp={self.sp} "
+                    f"needs heads % sp == 0 (and heads_kv % sp == 0 for GQA); "
+                    f"got heads={heads}, heads_kv={heads_kv}, sp={self.sp} "
                     "— every training step would fall back to unsharded "
                     "attention (use sp_impl='ring' or adjust heads)"
                 )

@@ -46,6 +46,8 @@ class CausalLM(nn.Module):
     dim: int = 128
     depth: int = 2
     heads: int = 4
+    heads_kv: int = 0  # 0 = heads; <heads = grouped-query attention (GQA):
+    #   smaller kv projections and a heads_kv-sized decode cache
     mlp_ratio: int = 4
     dropout: float = 0.0
     attn_fn: Callable | None = None  # sp island (brings its OWN causal flag)
@@ -113,7 +115,8 @@ class CausalLM(nn.Module):
                     "dropout and MoE blocks don't compose with pp_stages"
                 )
             x = StackedBlocks(
-                dim=self.dim, heads=self.heads, n_stages=self.pp_stages,
+                dim=self.dim, heads=self.heads, heads_kv=self.heads_kv,
+                n_stages=self.pp_stages,
                 per_stage=self.depth // self.pp_stages, mlp_ratio=self.mlp_ratio,
                 attn_fn=attn_fn, pipeline_fn=self.pipeline_fn,
                 block_remat=self.block_remat, rope=rope, dtype=self.dtype,
@@ -133,7 +136,8 @@ class CausalLM(nn.Module):
         extra = {"decode": True, "max_len": max_len} if decode else {}
         for i in range(self.depth):
             x = block_cls(
-                dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
+                dim=self.dim, heads=self.heads, heads_kv=self.heads_kv,
+                mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout, attn_fn=attn_fn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,

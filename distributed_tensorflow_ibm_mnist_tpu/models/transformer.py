@@ -79,6 +79,10 @@ def _resolve_attn(attn_fn: Callable | None, attn: str) -> Callable:
 class TransformerBlock(nn.Module):
     dim: int
     heads: int
+    heads_kv: int = 0  # 0 = heads (MHA).  Grouped-query attention: K/V
+    #   projected to heads_kv < heads head groups — smaller kv params and a
+    #   heads_kv-sized decode cache; the flash kernel routes q-heads to
+    #   shared K/V blocks via index maps (no repeat copies)
     mlp_ratio: int = 4
     dropout: float = 0.0
     attn_fn: Callable | None = None
@@ -99,9 +103,24 @@ class TransformerBlock(nn.Module):
         head_dim = self.dim // self.heads
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_attn")(x)
-        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
-        qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        hkv = self.heads_kv or self.heads
+        if hkv == self.heads:
+            qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
+            qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            if self.heads % hkv:
+                raise ValueError(
+                    f"heads ({self.heads}) must be a multiple of heads_kv ({hkv})"
+                )
+            # GQA: separate projections — q at full width, k/v at the
+            # grouped width (the param saving IS the feature).  Named
+            # q_proj/kv_proj for the Megatron TP rule.
+            q = nn.Dense(self.dim, dtype=self.dtype, name="q_proj")(h)
+            kv = nn.Dense(2 * hkv * head_dim, dtype=self.dtype, name="kv_proj")(h)
+            q = q.reshape(b, s, self.heads, head_dim)
+            kv = kv.reshape(b, s, 2, hkv, head_dim)
+            k, v = kv[:, :, 0], kv[:, :, 1]
         if decode:
             o = self._decode_attention(q, k, v, max_len)
         else:
@@ -148,10 +167,11 @@ class TransformerBlock(nn.Module):
         if max_len <= 0:
             raise ValueError("decode=True needs max_len > 0 (the KV-cache size)")
         b, s, h, d = q.shape
+        hkv = k.shape[2]  # GQA: the cache is heads_kv-sized — the memory win
         cache_k = self.variable(
-            "cache", "k", lambda: jnp.zeros((b, max_len, h, d), self.dtype))
+            "cache", "k", lambda: jnp.zeros((b, max_len, hkv, d), self.dtype))
         cache_v = self.variable(
-            "cache", "v", lambda: jnp.zeros((b, max_len, h, d), self.dtype))
+            "cache", "v", lambda: jnp.zeros((b, max_len, hkv, d), self.dtype))
         idx_var = self.variable(
             "cache", "index", lambda: jnp.zeros((), jnp.int32))
         idx = idx_var.value
@@ -169,12 +189,24 @@ class TransformerBlock(nn.Module):
         q32 = q.astype(jnp.float32) * (d ** -0.5)
         k32 = cache_k.value.astype(jnp.float32)
         v32 = cache_v.value.astype(jnp.float32)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
         k_pos = jnp.arange(max_len)
         q_pos = idx + jnp.arange(s)
         mask = k_pos[None, :] <= q_pos[:, None]  # (S, max_len), causal prefix
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v32)
+        if hkv != h:
+            # grouped einsum against the hkv-sized cache — no materialized
+            # repeat (the smaller cache bandwidth IS the GQA decode win)
+            qg = q32.reshape(b, s, hkv, h // hkv, d)
+            scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k32)
+            scores = jnp.where(mask[None, None, None], scores, -1e30)
+            out = jnp.einsum(
+                "bhgqk,bkhd->bqhgd", jax.nn.softmax(scores, axis=-1), v32
+            ).reshape(b, s, h, d)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v32
+            )
         return out.astype(self.dtype)
 
 
@@ -200,6 +232,7 @@ class StackedBlocks(nn.Module):
     heads: int
     n_stages: int
     per_stage: int
+    heads_kv: int = 0
     mlp_ratio: int = 4
     attn_fn: Callable | None = None
     attn: str = "vanilla"
@@ -215,7 +248,8 @@ class StackedBlocks(nn.Module):
         from jax import lax
 
         block = TransformerBlock(
-            dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
+            dim=self.dim, heads=self.heads, heads_kv=self.heads_kv,
+            mlp_ratio=self.mlp_ratio,
             dropout=0.0, attn_fn=self.attn_fn, attn=self.attn, rope=self.rope,
             dtype=self.dtype,
         )
@@ -262,6 +296,7 @@ class VisionTransformer(nn.Module):
     dim: int = 128
     depth: int = 4
     heads: int = 4
+    heads_kv: int = 0  # 0 = heads; <heads = grouped-query attention
     mlp_ratio: int = 4
     num_classes: int = 10
     dropout: float = 0.0
@@ -306,7 +341,8 @@ class VisionTransformer(nn.Module):
                     "dropout and MoE blocks don't compose with pp_stages"
                 )
             x = StackedBlocks(
-                dim=self.dim, heads=self.heads, n_stages=self.pp_stages,
+                dim=self.dim, heads=self.heads, heads_kv=self.heads_kv,
+                n_stages=self.pp_stages,
                 per_stage=self.depth // self.pp_stages, mlp_ratio=self.mlp_ratio,
                 attn_fn=self.attn_fn, attn=self.attn, pipeline_fn=self.pipeline_fn,
                 block_remat=self.block_remat, dtype=self.dtype, name="pipe_blocks",
@@ -324,7 +360,8 @@ class VisionTransformer(nn.Module):
         )
         for i in range(self.depth):
             x = block_cls(
-                dim=self.dim, heads=self.heads, mlp_ratio=self.mlp_ratio,
+                dim=self.dim, heads=self.heads, heads_kv=self.heads_kv,
+                mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout, attn_fn=self.attn_fn, attn=self.attn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,

@@ -206,19 +206,48 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
         dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
+def _to_bh(x, s_pad):
+    b, s, h, d = x.shape
+    x = x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    if s_pad:
+        x = jnp.pad(x, ((0, 0), (0, s_pad), (0, 0)))
+    return x
+
+
 def _prepare(q, k, v):
-    """(B, S, H, D) -> (B*H, S_pad, D) plus the static real sizes.
+    """(B, S, H, D)/(B, S, H_kv, D) -> (B*H, S_pad, D)/(B*H_kv, S_pad, D)
+    plus the static real sizes.
 
     Only the sequence is padded (to the 8-sublane tile); head_dim rides
     through unpadded — see the module docstring for why lane-padding D is
-    pure waste."""
+    pure waste.  ``H_kv < H`` is grouped-query attention: K/V stay at
+    their own head count in HBM and the kernels' BlockSpec index maps
+    route each q-head to its group's K/V block — no materialized
+    ``jnp.repeat`` copies (that is the point of GQA's bandwidth story)."""
     b, s, h, d = q.shape
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    q, k, v = to_bh(q), to_bh(k), to_bh(v)
+    hkv = k.shape[2]
+    if h % max(1, hkv) or v.shape[2] != hkv:
+        raise ValueError(
+            f"q heads ({h}) must be a multiple of matching k/v heads "
+            f"({k.shape[2]}/{v.shape[2]})"
+        )
     s_pad = (-s) % 8
-    if s_pad:
-        q, k, v = (jnp.pad(x, ((0, 0), (0, s_pad), (0, 0))) for x in (q, k, v))
-    return q, k, v, (b, s, h, d)
+    return (_to_bh(q, s_pad), _to_bh(k, s_pad), _to_bh(v, s_pad),
+            (b, s, h, d, hkv))
+
+
+def _kv_spec(block_k: int, d: int, h: int, hkv: int, k_axis: int):
+    """BlockSpec for a K/V operand under grouped heads: grid dim 0 runs
+    over B*H q-heads; the index map folds that to the owning kv-head's row
+    of the (B*H_kv, S_pad, D) array.  ``k_axis`` names which of the two
+    non-leading grid indices walks the K/V sequence tiles."""
+    g = h // hkv
+
+    def index_map(b_, i, j):
+        kv_row = (b_ // h) * hkv + (b_ % h) // g
+        return (kv_row, j if k_axis == 2 else i, 0)
+
+    return pl.BlockSpec((1, block_k, d), index_map)
 
 
 def _grid_params(interpret):
@@ -241,7 +270,7 @@ def _flash(q, k, v, causal, interpret):
 def _flash_fwd(q, k, v, causal, interpret):
     if interpret is None:
         interpret = not _on_tpu()
-    qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
+    qp, kp, vp, (b, s, h, d, hkv) = _prepare(q, k, v)
     bh, sp, _ = qp.shape
     block_q = _pick_block(sp, _BLOCK_Q)
     block_k = _pick_block(sp, _BLOCK_K)
@@ -256,8 +285,8 @@ def _flash_fwd(q, k, v, causal, interpret):
         grid=(bh, sp // block_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            _kv_spec(block_k, d, h, hkv, k_axis=2),
+            _kv_spec(block_k, d, h, hkv, k_axis=2),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
@@ -295,7 +324,7 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
     (parallel/ring_attention.py)."""
     if interpret is None:
         interpret = not _on_tpu()
-    qp, kp, vp, (b, s, h, d) = _prepare(q, k, v)
+    qp, kp, vp, (b, s, h, d, hkv) = _prepare(q, k, v)
     gp = _prepare(g, g, g)[0]
     bh, sp, _ = qp.shape
     block_q = _pick_block(sp, _BLOCK_Q)
@@ -304,14 +333,17 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
     n_k = sp // block_k
     sm_scale = d**-0.5
 
+    # dK/dV are produced PER Q-HEAD (shape B*H like q) and group-reduced
+    # below: under GQA one kv-head serves h/hkv q-heads, and accumulating
+    # across them inside the kernel would race the "parallel" grid dim.
     dkv = pl.pallas_call(
         partial(_dkv_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
                 n_q=n_q, s_real=s, causal=causal),
         grid=(bh, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),   # q tile
-            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),   # k tile
-            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),   # v tile
+            _kv_spec(block_k, d, h, hkv, k_axis=1),                       # k tile
+            _kv_spec(block_k, d, h, hkv, k_axis=1),                       # v tile
             pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),   # do tile
             pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),   # lse
             pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),   # delta
@@ -338,8 +370,8 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            _kv_spec(block_k, d, h, hkv, k_axis=2),
+            _kv_spec(block_k, d, h, hkv, k_axis=2),
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
@@ -350,10 +382,16 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
         **_grid_params(interpret),
     )(qp, kp, vp, gp, lse, delta)
 
-    def from_bh(x):
-        return x[:, :s, :].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    def from_bh(x, n_heads):
+        return x[:, :s, :].reshape(b, n_heads, s, d).transpose(0, 2, 1, 3)
 
-    return from_bh(dq_p), from_bh(dk_p), from_bh(dv_p)
+    def from_bh_grouped(x):
+        x = x[:, :s, :].reshape(b, h, s, d)
+        if hkv != h:
+            x = x.reshape(b, hkv, h // hkv, s, d).sum(axis=2)
+        return x.transpose(0, 2, 1, 3)
+
+    return from_bh(dq_p, h), from_bh_grouped(dk_p), from_bh_grouped(dv_p)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

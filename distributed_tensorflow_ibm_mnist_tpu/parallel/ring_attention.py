@@ -31,9 +31,28 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
 
 
+def _expand_kv_groups(q, k, v):
+    """Grouped-query attention in the reference paths: K/V with H_kv < H
+    heads are repeated up to H (the flash kernel instead routes q-heads to
+    shared K/V blocks via index maps — zero copies; this dense form is the
+    ground truth the kernel is tested against)."""
+    if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"q heads ({q.shape[2]}) must be a multiple of k/v heads "
+                f"({k.shape[2]})"
+            )
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    return k, v
+
+
 def vanilla_attention(q, k, v, causal: bool = False):
-    """Plain softmax attention, (B, S, H, D) layout — the ring's ground truth."""
+    """Plain softmax attention, (B, S, H, D) layout — the ring's ground
+    truth.  K/V may carry H_kv < H heads (GQA); they are group-repeated."""
     dtype = q.dtype
+    k, v = _expand_kv_groups(q, k, v)
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -46,8 +65,14 @@ def vanilla_attention(q, k, v, causal: bool = False):
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
-    """shard_map body: local (B, S_local, H, D) shards of a sharded sequence."""
+    """shard_map body: local (B, S_local, H, D) shards of a sharded sequence.
+
+    GQA note: the dense inner expands K/V groups up front (and so rotates
+    the expanded copies around the ring); the flash inner keeps K/V at
+    H_kv and lets the kernel's index maps do the routing — prefer it when
+    bandwidth matters."""
     dtype = q.dtype
+    k, v = _expand_kv_groups(q, k, v)
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)

@@ -76,6 +76,7 @@ def make_ulysses_attention(
             q.shape[0] % b_size == 0
             and q.shape[1] % s_size == 0
             and q.shape[2] % s_size == 0  # heads split across the seq axis
+            and k.shape[2] % s_size == 0  # GQA: kv heads split too
         )
         if not divisible:
             # same inner kernel as the sharded path, just unsharded — the

@@ -118,8 +118,9 @@ def megatron_rule(n_shards: int, axis: str = "model") -> SpecRule:
             return P(None, None, None, axis)  # conv output channels
         if kind == "kernel" and ndim == 2:
             d_in, d_out = shape
-            if name == "qkv" and d_out % n_shards == 0:
-                return P(None, axis)
+            if name in ("qkv", "q_proj", "kv_proj") and d_out % n_shards == 0:
+                return P(None, axis)  # column-parallel (GQA's split
+                #   q/kv projections shard like the fused qkv)
             if name == "proj" and d_in % n_shards == 0:
                 return P(axis, None)
             if re.fullmatch(r"fc\d*", name) and d_out % n_shards == 0:
@@ -129,7 +130,7 @@ def megatron_rule(n_shards: int, axis: str = "model") -> SpecRule:
         if kind == "embedding" and ndim == 2 and shape[1] % n_shards == 0:
             return P(None, axis)  # token embedding: feature dim sharded
         if kind == "bias" and ndim == 1 and shape[0] % n_shards == 0:
-            if name == "qkv" or re.fullmatch(r"fc\d*", name):
+            if name in ("qkv", "q_proj", "kv_proj") or re.fullmatch(r"fc\d*", name):
                 return P(axis)  # match the column-parallel output sharding
         return P()
 
