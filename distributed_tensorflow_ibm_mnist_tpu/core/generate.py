@@ -30,6 +30,29 @@ import jax
 import jax.numpy as jnp
 
 
+def _cache_from_sown(intermediates, p: int, max_len: int):
+    """Assemble the decode-cache pytree from the K/V each block sowed
+    during the forward prefill: pad (B, P, H_kv, D) to the max_len cache
+    and set every block's write index to P."""
+    cache = {}
+    for name, sub in intermediates.items():
+        if "kv_cache" not in sub:
+            continue
+        k, v = sub["kv_cache"][0]
+        pad = ((0, 0), (0, max_len - p), (0, 0), (0, 0))
+        cache[name] = {
+            "k": jnp.pad(k, pad),
+            "v": jnp.pad(v, pad),
+            "index": jnp.asarray(p, jnp.int32),
+        }
+    if not cache:
+        raise ValueError(
+            "prefill sowed no K/V — the model must pass sow_kv through to "
+            "its TransformerBlocks (CausalLM does)"
+        )
+    return cache
+
+
 def make_generator(
     model,
     max_len: int,
@@ -46,6 +69,8 @@ def make_generator(
     """
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
+    if getattr(model, "sow_kv", None) is False:
+        model = model.clone(sow_kv=True)  # arm the flash-prefill capture
 
     def pick(logits, rng):
         if temperature == 0.0:
@@ -67,13 +92,17 @@ def make_generator(
                     "PRNGKey(0) sample)"
                 )
             rng = jax.random.PRNGKey(0)  # greedy: rngs are split but unused
-        # Prefill: one decode-mode pass over the whole prompt populates
-        # every block's KV cache and yields the next-token logits.
+        # FLASH PREFILL: run the prompt through the ordinary forward (the
+        # model's own attention — the Pallas flash kernel for attn="flash")
+        # with each block sowing its rotated K/V, then assemble the decode
+        # cache from the sown tensors.  A decode-mode prefill would attend
+        # every prompt position over the full max_len cache — O(P*max_len)
+        # scores, OOM for long prompts; this path is O(P^2)-blockwise
+        # through the kernel and never materializes more.
         logits, vars_ = model.apply(
-            {"params": params}, prompt, decode=True, max_len=max_len,
-            mutable=["cache"],
+            {"params": params}, prompt, mutable=["intermediates"],
         )
-        cache = vars_["cache"]
+        cache = _cache_from_sown(vars_["intermediates"], p, max_len)
         rng, r0 = jax.random.split(rng)
         first = pick(logits[:, -1], r0)
 

@@ -805,8 +805,23 @@ class Trainer:
             )
         from distributed_tensorflow_ibm_mnist_tpu.core.generate import generate
 
+        if self.pp > 1:
+            raise ValueError(
+                "generate() from a pp>1 run is unsupported: params are "
+                "stage-stacked (pipe_blocks) and the decode path runs the "
+                "plain block stack — restack or train with pp=1 to decode"
+            )
+        # a clean single-device model: the trainer's own instance may carry
+        # sp/pp/moe islands (shard_map over the training mesh) that have no
+        # business in the decode path; params transfer by name
+        clean_kwargs = {
+            k: v for k, v in self.config.model_kwargs.items()
+            if k not in ("attn_fn", "moe_fn", "pipeline_fn", "pp_stages")
+        }
+        model = get_model(self.config.model, num_classes=self.num_classes,
+                          **clean_kwargs)
         params = jax.device_put(jax.device_get(self.state.params))
-        return generate(self.model, params, prompt, max_new,
+        return generate(model, params, prompt, max_new,
                         max_len=max_len, temperature=temperature, rng=rng)
 
     def evaluate(self) -> dict[str, float]:

@@ -56,6 +56,8 @@ class CausalLM(nn.Module):
     pos: str = "rope"  # 'rope' (rotary, default: length-extrapolating, no
     #   per-position params) | 'learned' (the (1, S, dim) table — bakes max
     #   length into the checkpoint; kept for ablation) | 'none'
+    sow_kv: bool = False  # sow per-block K/V on the normal forward (the
+    #   flash-prefill capture; core/generate.py clones the model with this)
     moe_every: int = 0
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
@@ -141,7 +143,8 @@ class CausalLM(nn.Module):
                 dropout=self.dropout, attn_fn=attn_fn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
-                moe_fn=self.moe_fn, rope=rope, dtype=self.dtype, name=f"block_{i}",
+                moe_fn=self.moe_fn, rope=rope, sow_kv=self.sow_kv,
+                dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

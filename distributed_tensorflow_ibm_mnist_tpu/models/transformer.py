@@ -94,6 +94,11 @@ class TransformerBlock(nn.Module):
     rope: bool = False  # rotary position embedding on q/k (apply_rope) —
     #   set by models whose pos="rope"; runs BEFORE attn_fn so sp islands
     #   receive already-rotated shards with global positions
+    sow_kv: bool = False  # sow the (post-rope) K/V into "intermediates" on
+    #   the NORMAL forward path — core/generate.py's flash prefill runs the
+    #   prompt through the ordinary (flash) attention and assembles the
+    #   decode cache from these, instead of attending over the max_len
+    #   cache (O(S*max_len) scores, OOM for long prompts)
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -126,6 +131,10 @@ class TransformerBlock(nn.Module):
         else:
             if self.rope:
                 q, k = apply_rope(q), apply_rope(k)
+            if self.sow_kv:
+                # absolute-position-rotated K/V, exactly what the decode
+                # cache stores — the flash-prefill capture point
+                self.sow("intermediates", "kv_cache", (k, v))
             o = _resolve_attn(self.attn_fn, self.attn)(q, k, v)
         o = o.reshape(b, s, self.dim)
         o = nn.Dense(self.dim, dtype=self.dtype, name="proj")(o)

@@ -103,6 +103,36 @@ def test_decode_past_trained_length_with_rope():
     assert out.shape == (1, 32)
 
 
+def test_flash_prefill_cache_matches_decode_prefill():
+    """make_generator prefills through the NORMAL forward (flash-friendly,
+    no O(P*max_len) score matrix) and assembles the cache from sown K/V —
+    it must equal the cache a decode-mode prefill builds."""
+    model, params = _model_and_params(seed=6)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 16, size=(2, 10)), jnp.int32)
+    max_len = 24
+
+    _, dec_vars = model.apply(
+        {"params": params}, prompt, decode=True, max_len=max_len,
+        mutable=["cache"],
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.core.generate import _cache_from_sown
+
+    sow_model = model.clone(sow_kv=True)
+    _, fwd_vars = sow_model.apply(
+        {"params": params}, prompt, mutable=["intermediates"],
+    )
+    built = _cache_from_sown(fwd_vars["intermediates"], 10, max_len)
+    for blk in dec_vars["cache"]:
+        assert int(built[blk]["index"]) == int(dec_vars["cache"][blk]["index"])
+        for key in ("k", "v"):
+            np.testing.assert_allclose(
+                np.asarray(built[blk][key], np.float32),
+                np.asarray(dec_vars["cache"][blk][key], np.float32),
+                atol=2e-5, err_msg=f"{blk}/{key}",
+            )
+
+
 def test_learned_pos_refuses_decode():
     model, params = _model_and_params(seed=5, pos="learned")
     with pytest.raises(ValueError, match="rope"):
