@@ -805,11 +805,12 @@ class Trainer:
             )
         from distributed_tensorflow_ibm_mnist_tpu.core.generate import generate
 
-        if self.pp > 1:
+        if self.pp > 1 or self.config.model_kwargs.get("pp_stages", 0):
             raise ValueError(
-                "generate() from a pp>1 run is unsupported: params are "
-                "stage-stacked (pipe_blocks) and the decode path runs the "
-                "plain block stack — restack or train with pp=1 to decode"
+                "generate() from a stage-stacked run is unsupported: params "
+                "live under pipe_blocks/stacked and the decode path runs the "
+                "plain block stack — train with pp=1 and no pp_stages to "
+                "decode"
             )
         # a clean single-device model: the trainer's own instance may carry
         # sp/pp/moe islands (shard_map over the training mesh) that have no

@@ -86,6 +86,20 @@ def _dot(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
+def _run_live_tiles(causal, qi, ki, block_q, block_k, compute):
+    """Execute ``compute`` only on live (at-or-below-diagonal) causal
+    tiles.  MUST mirror the clamp formulas in _kv_spec/_q_side_spec: a
+    dead step's operand refs point at the previous live tile (so Pallas
+    skips the DMA), and this gate skips the compute that would otherwise
+    read that stale block."""
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _run():
+            compute()
+    else:
+        compute()
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
                 *, sm_scale, block_q, block_k, n_k, s_real, causal):
     # grid (bh, q-tile, k-tile), k innermost; scratch carries the online
@@ -119,11 +133,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
         l_sc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_sc[...] = acc_prev * corr + _dot(p.astype(v.dtype), v, ((1,), (0,)))
 
-    # NOTE: gating dead above-diagonal causal tiles with pl.when was measured
-    # on v5e and does NOT help: block DMA is issued by the BlockSpec pipeline
-    # regardless of the body predicate, and the scalar guard costs pipeline
-    # overlap (S=8192 causal: 860ms gated vs ~720ms ungated). Keep unconditional.
-    _compute()
+    # Causal tile-skip, round-3 form: dead above-diagonal steps are gated
+    # out AND their K/V index maps are clamped to the previous live tile
+    # (see _flash_fwd), so Pallas sees an unchanged block index and issues
+    # NO DMA — the round-2 rejection (860 ms gated vs 720 ms ungated)
+    # gated the body but left the BlockSpec walking dead tiles, paying the
+    # copies anyway.  Dead steps now cost only grid-step overhead.
+    _run_live_tiles(causal, qi, ki, block_q, block_k, _compute)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -163,7 +179,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         ds = p * (dp - delta) * sm_scale
         dk_sc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
-    _compute()  # see causal-gating NOTE in _fwd_kernel
+    # causal skip: see the gating note in _fwd_kernel (same live condition;
+    # here the q index maps are clamped instead of the K/V ones)
+    _run_live_tiles(causal, qi, ki, block_q, block_k, _compute)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -199,7 +217,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
         ds = p * (dp - delta) * sm_scale
         dq_sc[...] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
-    _compute()  # see causal-gating NOTE in _fwd_kernel
+    # causal skip: see the gating note in _fwd_kernel
+    _run_live_tiles(causal, qi, ki, block_q, block_k, _compute)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -236,18 +255,45 @@ def _prepare(q, k, v):
             (b, s, h, d, hkv))
 
 
-def _kv_spec(block_k: int, d: int, h: int, hkv: int, k_axis: int):
+def _kv_spec(block_k: int, d: int, h: int, hkv: int, k_axis: int,
+             causal_clamp_bq: int = 0):
     """BlockSpec for a K/V operand under grouped heads: grid dim 0 runs
     over B*H q-heads; the index map folds that to the owning kv-head's row
     of the (B*H_kv, S_pad, D) array.  ``k_axis`` names which of the two
-    non-leading grid indices walks the K/V sequence tiles."""
+    non-leading grid indices walks the K/V sequence tiles.
+
+    ``causal_clamp_bq`` (the q block size; fwd/dq layouts only) arms the
+    causal tile-skip: dead above-diagonal steps get their k index CLAMPED
+    to the last live tile, so Pallas sees an unchanged block index and
+    skips the DMA entirely while the kernel body skips the compute — the
+    mechanism that makes the skip actually pay (see the gating note in
+    _fwd_kernel)."""
     g = h // hkv
 
     def index_map(b_, i, j):
         kv_row = (b_ // h) * hkv + (b_ % h) // g
-        return (kv_row, j if k_axis == 2 else i, 0)
+        kk = j if k_axis == 2 else i
+        if causal_clamp_bq:
+            qi = i if k_axis == 2 else j
+            kk = jnp.minimum(kk, ((qi + 1) * causal_clamp_bq - 1) // block_k)
+        return (kv_row, kk, 0)
 
     return pl.BlockSpec((1, block_k, d), index_map)
+
+
+def _q_side_spec(block_q: int, d_or_1: int, block_k: int,
+                 causal_clamp: bool):
+    """BlockSpec for q/do/lse/delta in the dK/dV layout (grid (bh, k-tile,
+    q-tile)): with the causal skip armed, dead leading q-tiles clamp UP to
+    the k-tile's first live q-tile — same no-DMA trick as _kv_spec."""
+
+    def index_map(b_, j, i):
+        ii = i
+        if causal_clamp:
+            ii = jnp.maximum(ii, (j * block_k) // block_q)
+        return (b_, ii, 0)
+
+    return pl.BlockSpec((1, block_q, d_or_1), index_map)
 
 
 def _grid_params(interpret):
@@ -285,8 +331,10 @@ def _flash_fwd(q, k, v, causal, interpret):
         grid=(bh, sp // block_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            _kv_spec(block_k, d, h, hkv, k_axis=2),
-            _kv_spec(block_k, d, h, hkv, k_axis=2),
+            _kv_spec(block_k, d, h, hkv, k_axis=2,
+                     causal_clamp_bq=block_q if causal else 0),
+            _kv_spec(block_k, d, h, hkv, k_axis=2,
+                     causal_clamp_bq=block_q if causal else 0),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
@@ -341,12 +389,12 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
                 n_q=n_q, s_real=s, causal=causal),
         grid=(bh, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),   # q tile
+            _q_side_spec(block_q, d, block_k, causal),                    # q tile
             _kv_spec(block_k, d, h, hkv, k_axis=1),                       # k tile
             _kv_spec(block_k, d, h, hkv, k_axis=1),                       # v tile
-            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),   # do tile
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),   # lse
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),   # delta
+            _q_side_spec(block_q, d, block_k, causal),                    # do tile
+            _q_side_spec(block_q, 1, block_k, causal),                    # lse
+            _q_side_spec(block_q, 1, block_k, causal),                    # delta
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
@@ -370,8 +418,10 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret):
         grid=(bh, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            _kv_spec(block_k, d, h, hkv, k_axis=2),
-            _kv_spec(block_k, d, h, hkv, k_axis=2),
+            _kv_spec(block_k, d, h, hkv, k_axis=2,
+                     causal_clamp_bq=block_q if causal else 0),
+            _kv_spec(block_k, d, h, hkv, k_axis=2,
+                     causal_clamp_bq=block_q if causal else 0),
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
