@@ -222,6 +222,7 @@ class Trainer:
                 self._attn_flops_meta = {
                     "seq": s, "heads": heads, "head_dim": dim // heads,
                     "depth": depth,
+                    "window": int(model_kwargs.get("window", 0) or 0),
                 }
         if self.sp > 1:
             # sequence parallelism: shard the model's attention over 'seq'
@@ -233,8 +234,13 @@ class Trainer:
                 )
             self._validate_sp_hot_path(model_kwargs, data)
             model_kwargs.setdefault("attn_fn", self._make_sp_attn(model_kwargs))
-        elif self.causal and model_accepts(config.model, "attn_fn"):
-            # causal without sp: same mask through the single-device kernel
+        elif (self.causal and model_accepts(config.model, "attn_fn")
+              and not model_accepts(config.model, "causal")):
+            # causal without sp, for families with no causal knob of their
+            # own (ViT): inject the masked single-device kernel.  Families
+            # that DO accept `causal` (causal_lm) build their own attn_fn —
+            # with their full option set (window, ...) — so injecting here
+            # would silently drop those options.
             from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
                 vanilla_attention,
             )
@@ -495,6 +501,12 @@ class Trainer:
                 f"must divide by dp={self.dp}, or every training step would "
                 "fall back to unsharded attention"
             )
+        if model_kwargs.get("window", 0):
+            raise ValueError(
+                f"sp={self.sp} with window={model_kwargs['window']}: sliding-"
+                "window attention is a single-device kernel feature for now "
+                "— the ring/Ulysses islands do not window-limit their hops"
+            )
         s = self._hot_seq_len(model_kwargs, data)
         if s is not None and s % self.sp:
             raise ValueError(
@@ -711,7 +723,7 @@ class Trainer:
         per_step = attention_flops(
             self.config.batch_size, meta["seq"], meta["heads"],
             meta["head_dim"], causal=self.causal, with_backward=True,
-            depth=meta["depth"],
+            depth=meta["depth"], window=meta.get("window", 0),
         )
         return per_step * self.steps_per_epoch / (self.dp * self.sp * self.pp)
 

@@ -48,6 +48,8 @@ class CausalLM(nn.Module):
     heads: int = 4
     heads_kv: int = 0  # 0 = heads; <heads = grouped-query attention (GQA):
     #   smaller kv projections and a heads_kv-sized decode cache
+    window: int = 0  # causal sliding-window attention width (0 = full
+    #   context); tile-skipped in the flash kernel so cost is S*window
     mlp_ratio: int = 4
     dropout: float = 0.0
     attn_fn: Callable | None = None  # sp island (brings its OWN causal flag)
@@ -72,6 +74,8 @@ class CausalLM(nn.Module):
     def __call__(self, tokens, train: bool = False, decode: bool = False,
                  max_len: int = 0):
         b, s = tokens.shape
+        if self.window < 0:
+            raise ValueError(f"window must be >= 0, got {self.window}")
         if decode and self.pos == "learned":
             raise ValueError(
                 "decode mode needs position-free params: pos='learned' bakes "
@@ -99,9 +103,11 @@ class CausalLM(nn.Module):
                     flash_attention,
                 )
 
-                attn_fn = partial(flash_attention, causal=self.causal)
+                attn_fn = partial(flash_attention, causal=self.causal,
+                                  window=self.window)
             else:
-                attn_fn = partial(vanilla_attention, causal=self.causal)
+                attn_fn = partial(vanilla_attention, causal=self.causal,
+                                  window=self.window)
         if self.pp_stages > 0:
             from distributed_tensorflow_ibm_mnist_tpu.models.transformer import (
                 StackedBlocks,
@@ -144,7 +150,7 @@ class CausalLM(nn.Module):
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
                 moe_fn=self.moe_fn, rope=rope, sow_kv=self.sow_kv,
-                dtype=self.dtype, name=f"block_{i}",
+                window=self.window, dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

@@ -94,6 +94,9 @@ class TransformerBlock(nn.Module):
     rope: bool = False  # rotary position embedding on q/k (apply_rope) —
     #   set by models whose pos="rope"; runs BEFORE attn_fn so sp islands
     #   receive already-rotated shards with global positions
+    window: int = 0  # causal sliding-window attention width (0 = full);
+    #   enforced by the model-built attn_fn on the training path and by the
+    #   decode mask here; requires a causal family
     sow_kv: bool = False  # sow the (post-rope) K/V into "intermediates" on
     #   the NORMAL forward path — core/generate.py's flash prefill runs the
     #   prompt through the ordinary (flash) attention and assembles the
@@ -201,6 +204,8 @@ class TransformerBlock(nn.Module):
         k_pos = jnp.arange(max_len)
         q_pos = idx + jnp.arange(s)
         mask = k_pos[None, :] <= q_pos[:, None]  # (S, max_len), causal prefix
+        if self.window:
+            mask &= k_pos[None, :] > q_pos[:, None] - self.window
         if hkv != h:
             # grouped einsum against the hkv-sized cache — no materialized
             # repeat (the smaller cache bandwidth IS the GQA decode win)

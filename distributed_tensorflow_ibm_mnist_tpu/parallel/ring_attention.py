@@ -48,9 +48,17 @@ def _expand_kv_groups(q, k, v):
     return k, v
 
 
-def vanilla_attention(q, k, v, causal: bool = False):
+def vanilla_attention(q, k, v, causal: bool = False, window: int = 0):
     """Plain softmax attention, (B, S, H, D) layout — the ring's ground
-    truth.  K/V may carry H_kv < H heads (GQA); they are group-repeated."""
+    truth.  K/V may carry H_kv < H heads (GQA); they are group-repeated.
+    ``window`` > 0 restricts each position to the last ``window`` keys
+    (causal sliding window; requires ``causal=True``)."""
+    if window:
+        if not causal:
+            raise ValueError("window > 0 is causal sliding-window attention; "
+                             "pass causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     dtype = q.dtype
     k, v = _expand_kv_groups(q, k, v)
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
@@ -59,6 +67,8 @@ def vanilla_attention(q, k, v, causal: bool = False):
     if causal:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        if window:
+            mask &= jnp.triu(jnp.ones((s_q, s_k), bool), -(window - 1))
         scores = jnp.where(mask, scores, -jnp.inf)
     out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v)
     return out.astype(dtype)

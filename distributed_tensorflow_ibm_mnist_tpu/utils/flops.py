@@ -78,24 +78,33 @@ def device_peak_tflops(device=None) -> float | None:
 def attention_flops(
     batch: int, seq: int, heads: int, head_dim: int, *,
     causal: bool = False, with_backward: bool = True, depth: int = 1,
+    window: int = 0,
 ) -> float:
     """Analytic matmul FLOPs of multi-head attention, standard model-FLOPs
     convention: forward is the QK^T and PV matmuls (4*B*S^2*H*D), backward
-    counted at 2x forward, causal attention halved.
+    counted at 2x forward, causal attention halved; a causal sliding
+    ``window`` caps each query at ``min(q+1, W)`` keys — summed exactly:
+    ``S*W - W*(W-1)/2`` scored pairs, continuous with the full-causal
+    count at W = S.
 
     This is the MFU-numerator convention of the scaling literature — the
     FLOPs the computation semantically NEEDS.  The flash kernels execute
-    more (the bwd recompute adds ~2 extra score matmuls, and causal tiles
-    are not skipped — a measured rejection, see ops/flash_attention.py), so
-    an MFU built on this count is conservative w.r.t. what the MXU actually
-    ran, matching how the dense path's XLA cost analysis treats it
-    (validated against each other in tests/test_flops.py).
+    somewhat more (the bwd recompute adds ~2 extra score matmuls, and tile
+    granularity rounds the causal/window boundaries up), so an MFU built
+    on this count is conservative w.r.t. what the MXU actually ran,
+    matching how the dense path's XLA cost analysis treats it (validated
+    against each other in tests/test_flops.py).
     """
-    f = 4.0 * batch * seq * seq * heads * head_dim * depth
+    if causal and window:
+        w = min(window, seq)
+        pairs = seq * w - w * (w - 1) / 2.0  # sum over queries of min(q+1, W)
+        f = 4.0 * batch * pairs * heads * head_dim * depth
+    else:
+        f = 4.0 * batch * seq * seq * heads * head_dim * depth
+        if causal:
+            f /= 2.0
     if with_backward:
         f *= 3.0
-    if causal:
-        f /= 2.0
     return f
 
 

@@ -196,7 +196,7 @@ def test_flash_supplement_gated_to_tpu():
         eval_batch_size=32,
     ))
     assert t._attn_flops_meta == {"seq": 32, "heads": 4, "head_dim": 16,
-                                  "depth": 1}
+                                  "depth": 1, "window": 0}
     assert t.causal is True  # family default folds into the supplement
     assert t._flash_attn_flops_per_epoch() == 0.0  # cpu backend
     # the number the TPU path would add: causal-halved, 3x-fwd, per-device
