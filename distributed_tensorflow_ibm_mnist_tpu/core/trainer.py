@@ -279,6 +279,7 @@ class Trainer:
             model_kwargs.setdefault("moe_fn", make_moe_dispatch_auto(
                 self.mesh, n_exp,
                 capacity_factor=model_kwargs.get("moe_capacity_factor", 2.0),
+                top_k=int(model_kwargs.get("moe_top_k", 1)),
             ))
         if config.remat == "blocks":
             if not model_accepts(config.model, "block_remat"):

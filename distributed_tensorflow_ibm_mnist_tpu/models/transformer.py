@@ -90,6 +90,7 @@ class TransformerBlock(nn.Module):
     use_moe: bool = False
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1  # experts per token: 1 = Switch, >1 = GShard top-k
     moe_fn: Callable | None = None  # expert-parallel dispatch island (make_moe_dispatch)
     rope: bool = False  # rotary position embedding on q/k (apply_rope) —
     #   set by models whose pos="rope"; runs BEFORE attn_fn so sp islands
@@ -153,7 +154,8 @@ class TransformerBlock(nn.Module):
 
             h = MoEBlock(
                 dim=self.dim, n_experts=self.n_experts, hidden_mult=self.mlp_ratio,
-                capacity_factor=self.moe_capacity_factor, ep_fn=self.moe_fn, name="moe",
+                capacity_factor=self.moe_capacity_factor, top_k=self.moe_top_k,
+                ep_fn=self.moe_fn, name="moe",
             )(h, train=train)
         else:
             h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype, name="dense_0")(h)
@@ -319,6 +321,7 @@ class VisionTransformer(nn.Module):
     moe_every: int = 0  # 0 = dense; k = every k-th block uses a MoE FFN
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1
     moe_fn: Callable | None = None
     pp_stages: int = 0  # >0: stack blocks (n_stages, per_stage, ...) for the
     #                     GPipe island — params shardable over 'pipe'
@@ -379,6 +382,7 @@ class VisionTransformer(nn.Module):
                 dropout=self.dropout, attn_fn=self.attn_fn, attn=self.attn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
+                moe_top_k=self.moe_top_k,
                 moe_fn=self.moe_fn, dtype=self.dtype, name=f"block_{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)

@@ -1,4 +1,5 @@
-"""Expert parallelism: Switch-style top-1 MoE with all_to_all dispatch.
+"""Expert parallelism: top-k MoE (Switch top-1 / GShard top-k) with
+all_to_all dispatch.
 
 The reference had no MoE (SURVEY.md §2.3); this completes the rebuild's
 parallelism-strategy inventory.  Design follows the Switch/GShard recipe,
@@ -38,22 +39,48 @@ def expert_capacity(n_tokens: int, n_experts: int, factor: float = 1.25) -> int:
     return max(1, int(n_tokens * factor / n_experts))
 
 
-def _route(x, w_router, n_experts: int, capacity: int):
-    """Top-1 routing -> (dispatch (T,E,C), combine (T,E,C), aux_loss)."""
+def _route(x, w_router, n_experts: int, capacity: int, top_k: int = 1):
+    """Top-k routing -> (dispatch (T,E,C), combine (T,E,C), aux_loss).
+
+    ``top_k=1`` is Switch; ``top_k>1`` is the GShard recipe: each token's
+    k chosen experts get a buffer slot in CHOICE-PRIORITY order (all first
+    choices fill before any second choice — a token's secondary pick is
+    the first dropped under pressure), and the combine weights are the
+    top-k router probabilities normalized over the k choices (fixed before
+    capacity; a capacity-dropped choice simply contributes nothing).
+    Everything stays static-shaped: k one-hot rounds unrolled at trace
+    time, dispatch/combine remain two dense einsums.
+    """
+    if not 1 <= top_k <= n_experts:
+        raise ValueError(
+            f"top_k must be in [1, n_experts={n_experts}], got {top_k}"
+        )
     logits = x @ w_router  # (T, E)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # (T,)
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)  # (T, E)
-    # position of each token within its expert's buffer, in arrival order
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
-    keep = (pos < capacity).astype(jnp.float32) * onehot
-    dispatch = keep[..., None] * jax.nn.one_hot(pos.astype(jnp.int32), capacity)  # (T,E,C)
-    combine = dispatch * gate[:, None, None]
-    # load-balancing ingredients: fraction-of-tokens / mean-router-prob per
-    # expert (the caller reduces these across shards BEFORE the product, so
-    # the distributed aux loss is exactly the global one)
-    frac_tokens = onehot.mean(axis=0)
+    topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # (T, k)
+    if top_k == 1:
+        gates = topk_probs  # Switch: the RAW router prob (its gradient
+        #   path; renormalizing would collapse it to a constant 1)
+    else:
+        gates = topk_probs / topk_probs.sum(axis=-1, keepdims=True)
+    counts = jnp.zeros((n_experts,), jnp.float32)  # filled slots per expert
+    dispatch = jnp.zeros((x.shape[0], n_experts, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    for c in range(top_k):
+        onehot = jax.nn.one_hot(topk_idx[:, c], n_experts, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + counts[None, :]) * onehot
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        slot = keep[..., None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity)  # (T, E, C)
+        dispatch = dispatch + slot
+        combine = combine + slot * gates[:, c, None, None]
+        counts = counts + keep.sum(axis=0)
+    # load-balancing ingredients from the PRIMARY choice (standard):
+    # fraction-of-tokens / mean-router-prob per expert (the caller reduces
+    # these across shards BEFORE the product, so the distributed aux loss
+    # is exactly the global one)
+    frac_tokens = jax.nn.one_hot(
+        topk_idx[:, 0], n_experts, dtype=jnp.float32).mean(axis=0)
     frac_probs = probs.mean(axis=0)
     return dispatch, combine, (frac_tokens, frac_probs)
 
@@ -70,16 +97,18 @@ def _aux_loss(frac_tokens, frac_probs, n_experts: int):
     return n_experts * jnp.sum(frac_tokens * frac_probs)
 
 
-def moe_ffn_local(params, x, n_experts: int, capacity: int):
+def moe_ffn_local(params, x, n_experts: int, capacity: int, top_k: int = 1):
     """Single-shard MoE forward: ``x`` (T, D) -> (out (T, D), aux_loss)."""
-    dispatch, combine, fracs = _route(x, params["router"], n_experts, capacity)
+    dispatch, combine, fracs = _route(x, params["router"], n_experts, capacity,
+                                      top_k)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     expert_out = _expert_ffn(params, expert_in)
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
     return out.astype(x.dtype), _aux_loss(*fracs, n_experts)
 
 
-def make_moe_dispatch(mesh: Mesh, n_experts: int, capacity: int, axis_name: str = "data"):
+def make_moe_dispatch(mesh: Mesh, n_experts: int, capacity: int,
+                      axis_name: str = "data", top_k: int = 1):
     """Build the expert-parallel MoE forward as a shard_map island.
 
     ``moe(params, x) -> (out, aux)`` where ``x`` is (T, D) sharded over
@@ -96,7 +125,8 @@ def make_moe_dispatch(mesh: Mesh, n_experts: int, capacity: int, axis_name: str 
         # x: local (T_local, D); expert params: local (E/A, ...) — this
         # shard's experts.  Route locally to ALL E experts, then all_to_all
         # so each shard runs only its own experts on everyone's tokens.
-        dispatch, combine, fracs = _route(x, params["router"], n_experts, capacity)
+        dispatch, combine, fracs = _route(x, params["router"], n_experts,
+                                          capacity, top_k)
         expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
         # (E, C, D) -> (E/A, A*C, D): block e of shard s lands on shard owning e
         expert_in = cl.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=1)
@@ -125,6 +155,7 @@ def make_moe_dispatch_auto(
     n_experts: int,
     capacity_factor: float = 2.0,
     axis_name: str = "data",
+    top_k: int = 1,
 ):
     """Shape-adaptive wrapper over :func:`make_moe_dispatch` — the trainer's
     config-driven EP hook (VERDICT.md round-1 item 2: ``make_moe_dispatch``
@@ -138,12 +169,14 @@ def make_moe_dispatch_auto(
     a = mesh.shape[axis_name]
 
     def moe(params, x):
+        # each token claims top_k slots, so the balanced-routing demand is
+        # t*top_k/E per expert — scale capacity by top_k (GShard recipe)
         t = x.shape[0]
         if n_experts % a or t % a:
-            cap = expert_capacity(t, n_experts, capacity_factor)
-            return moe_ffn_local(params, x, n_experts, cap)
-        cap = expert_capacity(t // a, n_experts, capacity_factor)
-        return make_moe_dispatch(mesh, n_experts, cap, axis_name)(params, x)
+            cap = expert_capacity(t * top_k, n_experts, capacity_factor)
+            return moe_ffn_local(params, x, n_experts, cap, top_k)
+        cap = expert_capacity((t // a) * top_k, n_experts, capacity_factor)
+        return make_moe_dispatch(mesh, n_experts, cap, axis_name, top_k)(params, x)
 
     return moe
 
@@ -179,6 +212,7 @@ class MoEBlock(nn.Module):
     n_experts: int = 8
     hidden_mult: int = 4
     capacity_factor: float = 2.0
+    top_k: int = 1  # experts per token: 1 = Switch, >1 = GShard top-k
     ep_fn: Callable | None = None
 
     @nn.compact
@@ -197,7 +231,9 @@ class MoEBlock(nn.Module):
         if self.ep_fn is not None:
             out, aux = self.ep_fn(params, tokens)
         else:
-            cap = expert_capacity(b * s, self.n_experts, self.capacity_factor)
-            out, aux = moe_ffn_local(params, tokens, self.n_experts, cap)
+            cap = expert_capacity(b * s * self.top_k, self.n_experts,
+                                  self.capacity_factor)
+            out, aux = moe_ffn_local(params, tokens, self.n_experts, cap,
+                                     self.top_k)
         self.sow("losses", "moe_aux", aux)
         return out.reshape(b, s, d)
