@@ -502,11 +502,12 @@ class Trainer:
                 f"must divide by dp={self.dp}, or every training step would "
                 "fall back to unsharded attention"
             )
-        if model_kwargs.get("window", 0):
+        if model_kwargs.get("window", 0) and cfg.sp_impl == "ring":
             raise ValueError(
-                f"sp={self.sp} with window={model_kwargs['window']}: sliding-"
-                "window attention is a single-device kernel feature for now "
-                "— the ring/Ulysses islands do not window-limit their hops"
+                f"sp={self.sp} with window={model_kwargs['window']}: the ring "
+                "rotates K/V shards and cannot window-limit its hops — use "
+                "sp_impl='ulysses' (full sequence local after the head "
+                "reshard, window passes through) or sp=1"
             )
         s = self._hot_seq_len(model_kwargs, data)
         if s is not None and s % self.sp:
@@ -560,7 +561,10 @@ class Trainer:
                 )
 
                 inner = flash_attention
-            return make_ulysses_attention(self.mesh, causal=self.causal, inner_attn=inner)
+            return make_ulysses_attention(
+                self.mesh, causal=self.causal, inner_attn=inner,
+                window=int(model_kwargs.get("window", 0) or 0),
+            )
         raise ValueError(_unknown_sp_impl_msg(cfg.sp_impl))  # direct-call guard;
         #   the Trainer path rejects unknown impls in _validate_sp_hot_path
 

@@ -33,7 +33,8 @@ from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
 from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import vanilla_attention
 
 
-def _ulysses_local(q, k, v, axis_name: str, causal: bool, inner_attn: Callable):
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, inner_attn: Callable,
+                   window: int = 0):
     """shard_map body: (B, S_local, H, D) shards -> head-sharded full-seq attn."""
     # seq-sharded -> head-sharded: split heads (axis 2) across the mesh axis,
     # gather the full sequence (axis 1).
@@ -41,7 +42,11 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool, inner_attn: Callable):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
     q_h, k_h, v_h = to_heads(q), to_heads(k), to_heads(v)  # (B, S, H/n, D)
-    out = inner_attn(q_h, k_h, v_h, causal=causal)
+    # the full sequence is LOCAL after the head reshard, so a sliding
+    # window passes straight through to the inner kernel (the ring, whose
+    # K/V never fully co-reside, cannot do this)
+    kw = {"window": window} if window else {}
+    out = inner_attn(q_h, k_h, v_h, causal=causal, **kw)
     # head-sharded -> seq-sharded: inverse transpose.
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -52,6 +57,7 @@ def make_ulysses_attention(
     seq_axis: str = "seq",
     causal: bool = False,
     inner_attn: Callable = vanilla_attention,
+    window: int = 0,
 ):
     """Build ``attn(q, k, v) -> out`` with sequence sharded over ``seq_axis``.
 
@@ -65,11 +71,13 @@ def make_ulysses_attention(
     """
     spec = P(batch_axis, seq_axis, None, None)
     fn = functools.partial(
-        _ulysses_local, axis_name=seq_axis, causal=causal, inner_attn=inner_attn
+        _ulysses_local, axis_name=seq_axis, causal=causal,
+        inner_attn=inner_attn, window=window,
     )
     island = shard_map_compat(fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
     b_size = mesh.shape[batch_axis] if batch_axis is not None else 1
     s_size = mesh.shape[seq_axis]
+    kw = {"window": window} if window else {}
 
     def attn(q, k, v):
         divisible = (
@@ -81,7 +89,7 @@ def make_ulysses_attention(
         if not divisible:
             # same inner kernel as the sharded path, just unsharded — the
             # implementation must not silently switch with the shape
-            return inner_attn(q, k, v, causal=causal)
+            return inner_attn(q, k, v, causal=causal, **kw)
         return island(q, k, v)
 
     return attn

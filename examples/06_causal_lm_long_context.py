@@ -13,11 +13,14 @@ On a multi-device mesh, swap the attention for
 ``make_ulysses_attention(...)`` — the same drop-in ``attn_fn`` slot.
 
 This walkthrough builds the net by hand to show the pieces; the same task
-is one config away since round 2::
+is one config away since round 2 (causal derives from the family since
+round 3 — and RoPE positions and grouped-query attention are each one
+model_kwargs entry; a sliding ``window`` also exists, but would defeat
+THIS task: the key lives at position 0, which is the point)::
 
-    RunConfig(model="causal_lm", dataset="retrieval", causal=True,
+    RunConfig(model="causal_lm", dataset="retrieval",
               dataset_kwargs={"vocab": 64, "seq_len": 1024},
-              model_kwargs={"attn": "flash"})
+              model_kwargs={"attn": "flash", "heads_kv": 2})
 
     python examples/06_causal_lm_long_context.py
 """

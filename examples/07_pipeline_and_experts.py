@@ -5,7 +5,9 @@ example trains (a) a ViT whose block stack streams through a GPipe pipeline
 (`pp=4`: stage-stacked params sharded over the 'pipe' mesh axis, microbatches
 hopping stages via ppermute) and (b) a Mixture-of-Experts ViT whose experts
 (and their adam moments) shard over 'data' with all_to_all token dispatch —
-wired automatically the moment a MoE model trains at dp>1. Needs 8 devices;
+wired automatically the moment a MoE model trains at dp>1 (Switch top-1 by
+default; `model_kwargs={"moe_top_k": 2}` switches to GShard top-2 routing
+with choice-priority capacity filling). Needs 8 devices;
 with fewer it self-arms the 8-device virtual CPU mesh:
 
     python examples/07_pipeline_and_experts.py

@@ -126,3 +126,26 @@ def test_sp_refuses_window(eight_devices):
     )
     with pytest.raises(ValueError, match="window"):
         Trainer(cfg)
+
+
+def test_ulysses_sp_with_window_matches_single_device(eight_devices):
+    """window composes with Ulysses SP (full sequence is local after the
+    head reshard): sp=2 windowed trajectory == unsharded windowed run."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 1, "heads": 4, "window": 16,
+                      "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 64},
+        n_train=256, n_test=64, batch_size=64, epochs=2, quiet=True,
+        eval_batch_size=32,
+    )
+    t1 = Trainer(RunConfig(name="w1", **base))
+    t1.fit()
+    tsp = Trainer(RunConfig(name="wsp", dp=2, sp=2, sp_impl="ulysses", **base))
+    tsp.fit()
+    a, b = jax.device_get((t1.state.params, tsp.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-3)
