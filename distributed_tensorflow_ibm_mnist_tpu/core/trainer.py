@@ -135,8 +135,14 @@ class Trainer:
         self.dp = config.dp if config.dp else max(
             1, len(jax.devices()) // (self.tp * self.sp * self.pp)
         )
-        if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1 or self.pp > 1):
-            mesh = make_mesh(dp=self.dp, tp=self.tp, sp=self.sp, pp=self.pp)
+        if config.dcn_dp < 1:
+            raise ValueError(f"dcn_dp must be >= 1, got {config.dcn_dp}")
+        # dcn_dp > 1 forces the mesh build so its multislice validation
+        # runs (a dp=1 run would otherwise silently ignore the request)
+        if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1
+                             or self.pp > 1 or config.dcn_dp > 1):
+            mesh = make_mesh(dp=self.dp, tp=self.tp, sp=self.sp, pp=self.pp,
+                             dcn_dp=config.dcn_dp)
         self.mesh = mesh
         if config.fsdp and self.dp <= 1:
             raise ValueError(

@@ -34,12 +34,31 @@ def shard_map_compat(f, mesh, in_specs, out_specs):
         return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
+def hybrid_mesh_shapes(
+    dp: int, tp: int, sp: int, pp: int, dcn_dp: int
+) -> tuple[tuple[int, int, int, int], tuple[int, int, int, int]]:
+    """Split a (data, model, seq, pipe) request into the per-slice (ICI)
+    and cross-slice (DCN) factor shapes ``create_hybrid_device_mesh``
+    expects: only the data axis spans slices (gradient all-reduce is the
+    one per-step collective that tolerates DCN latency; model/seq/pipe
+    collectives stay on intra-slice ICI), so dcn_dp must divide dp."""
+    if dcn_dp < 1:
+        raise ValueError(f"dcn_dp must be >= 1, got {dcn_dp}")
+    if dp % dcn_dp:
+        raise ValueError(
+            f"dcn_dp ({dcn_dp}) must divide dp ({dp}): the data axis factors "
+            "as (cross-slice x within-slice)"
+        )
+    return (dp // dcn_dp, tp, sp, pp), (dcn_dp, 1, 1, 1)
+
+
 def make_mesh(
     dp: int | None = None,
     tp: int = 1,
     sp: int = 1,
     pp: int = 1,
     devices: list | None = None,
+    dcn_dp: int = 1,
 ) -> Mesh:
     """Build a ``(data, model, seq, pipe)`` mesh over the visible devices.
 
@@ -48,6 +67,17 @@ def make_mesh(
     Axis order puts ``data`` outermost (DCN-friendly across slices) and the
     compute-coupled axes (``model``/``seq``/``pipe``) innermost so their
     collectives ride adjacent ICI links.
+
+    ``dcn_dp > 1`` is the MULTISLICE form: the devices span that many TPU
+    slices (each device carries a ``slice_index``), the data axis factors
+    as (dcn_dp slices x dp/dcn_dp within each slice), and
+    ``mesh_utils.create_hybrid_device_mesh`` lays devices out so only the
+    data axis's gradient all-reduce crosses DCN — model/seq/pipe
+    collectives never leave a slice's ICI.  This is the reference's
+    multi-worker scaling story (SURVEY.md §2.4: PS/NCCL across IBM-Cloud
+    workers) in TPU-native form; single-slice environments (this sandbox,
+    the virtual CPU mesh) refuse it with a clear error rather than
+    silently degrading to a flat mesh.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
@@ -60,6 +90,32 @@ def make_mesh(
     need = dp * tp * sp * pp
     if need > n:
         raise ValueError(f"mesh ({dp}x{tp}x{sp}x{pp}) needs {need} devices, have {n}")
+    if dcn_dp > 1:
+        ici_shape, dcn_shape = hybrid_mesh_shapes(dp, tp, sp, pp, dcn_dp)
+        # pick need/dcn_dp devices from EACH slice (flat devices[:need]
+        # would grab slice 0's chips first and see "one slice")
+        per_slice = need // dcn_dp
+        groups: dict = {}
+        for d in devices:
+            groups.setdefault(getattr(d, "slice_index", None), []).append(d)
+        usable = sorted(
+            s for s, g in groups.items() if s is not None and len(g) >= per_slice
+        )
+        if len(usable) < dcn_dp:
+            found = sorted(s for s in groups if s is not None)
+            raise ValueError(
+                f"dcn_dp={dcn_dp} needs {dcn_dp} TPU slices with >= "
+                f"{per_slice} devices each (found slice indices "
+                f"{found or 'none'}); multislice runs come from the TPU "
+                "runtime, not this host"
+            )
+        chosen = [d for s in usable[:dcn_dp] for d in groups[s][:per_slice]]
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=chosen
+        )
+        return Mesh(arr, ("data", "model", "seq", "pipe"))
     arr = _device_grid((dp, tp, sp, pp), devices[:need])
     return Mesh(arr, ("data", "model", "seq", "pipe"))
 

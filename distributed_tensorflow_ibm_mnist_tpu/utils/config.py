@@ -77,6 +77,10 @@ class RunConfig:
     #                           of ticks are idle per stage.
     fsdp: bool = False  # ZeRO-3: shard params + opt state over 'data' (needs
     #                     dp>1; composes with tp into the 2D TP-within layout)
+    dcn_dp: int = 1  # multislice: how many TPU slices the data axis spans
+    #   (dcn_dp must divide dp; only the gradient all-reduce crosses DCN,
+    #   model/seq/pipe collectives stay on each slice's ICI — see
+    #   parallel/mesh.make_mesh)
     # run control
     seed: int = 0
     target_accuracy: float | None = None  # stop early when test acc reaches this

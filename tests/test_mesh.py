@@ -82,3 +82,44 @@ def test_tpu_subset_falls_back_to_list_order(monkeypatch):
     monkeypatch.setattr(jax, "devices", lambda *a, **k: fakes)
     grid = mesh_mod._device_grid((4, 1, 1, 1), fakes[:4])
     assert [d.id for d in grid.flat] == [0, 1, 2, 3]
+
+
+def test_hybrid_mesh_shapes():
+    """Multislice factoring: only the data axis crosses DCN."""
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import hybrid_mesh_shapes
+
+    assert hybrid_mesh_shapes(8, 2, 1, 1, dcn_dp=2) == ((4, 2, 1, 1), (2, 1, 1, 1))
+    assert hybrid_mesh_shapes(4, 1, 1, 1, dcn_dp=4) == ((1, 1, 1, 1), (4, 1, 1, 1))
+    with pytest.raises(ValueError, match="divide"):
+        hybrid_mesh_shapes(6, 1, 1, 1, dcn_dp=4)
+    with pytest.raises(ValueError, match=">= 1"):
+        hybrid_mesh_shapes(4, 1, 1, 1, dcn_dp=0)
+
+
+def test_dcn_dp_refused_without_multislice_devices(eight_devices):
+    """Virtual CPU devices carry no slice_index: dcn_dp>1 must refuse with
+    a clear error instead of silently building a flat mesh."""
+    with pytest.raises(ValueError, match="slice"):
+        make_mesh(dp=8, dcn_dp=2)
+
+
+def test_config_dcn_dp_plumbs_to_mesh(eight_devices):
+    """RunConfig.dcn_dp reaches make_mesh (and fails loudly here, where no
+    multislice runtime exists) — even at dp=1, where the mesh build is
+    otherwise skipped."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, synthetic=True,
+        n_train=64, n_test=32, batch_size=32, epochs=1, quiet=True,
+        dp=8, dcn_dp=2,
+    )
+    with pytest.raises(ValueError, match="slice"):
+        Trainer(cfg)
+    # dp=1 must not silently ignore the multislice request...
+    with pytest.raises(ValueError, match="divide"):
+        Trainer(cfg.replace(dp=1))
+    # ...and invalid values are refused, not clamped
+    with pytest.raises(ValueError, match=">= 1"):
+        Trainer(cfg.replace(dcn_dp=0))
