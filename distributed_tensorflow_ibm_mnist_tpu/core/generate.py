@@ -53,28 +53,67 @@ def _cache_from_sown(intermediates, p: int, max_len: int):
     return cache
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Standard sampling filters on (B, V) logits, jit-friendly (static
+    shapes, masking instead of truncation).
+
+    ``top_k > 0`` keeps the k highest logits; ``0 < top_p < 1`` keeps the
+    smallest set of tokens whose softmax mass reaches p (nucleus), always
+    including the argmax.  Both compose (k first, then p).
+    """
+    neg = jnp.finfo(logits.dtype).min
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # (B, 1)
+        logits = jnp.where(logits < kth, neg, logits)
+    if 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep ranks whose PRECEDING mass is < p (so the argmax always
+        # survives); the cutoff logit is the smallest kept one
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1)
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
 def make_generator(
     model,
     max_len: int,
     max_new: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
 ) -> Callable:
     """Build a jitted ``gen(params, prompt, rng=None) -> (B, P+max_new)``.
 
     ``prompt`` is int tokens (B, P) with P + max_new <= max_len (the KV
     cache size, static).  ``temperature == 0`` decodes greedily (argmax);
-    otherwise logits/temperature are sampled categorically with ``rng``.
-    The returned callable is compiled once per (prompt length, batch)
-    shape; reuse it across calls.
+    otherwise logits/temperature are sampled categorically with ``rng``,
+    optionally filtered by ``top_k`` (keep the k best) and/or ``top_p``
+    (nucleus: smallest set reaching p probability mass).  The returned
+    callable is compiled once per (prompt length, batch) shape; reuse it
+    across calls.
     """
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
+    if temperature == 0.0 and (top_k or top_p):
+        raise ValueError(
+            "top_k/top_p filter a SAMPLING distribution; set temperature > 0"
+        )
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     if getattr(model, "sow_kv", None) is False:
         model = model.clone(sow_kv=True)  # arm the flash-prefill capture
 
     def pick(logits, rng):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits, top_k, top_p)
         return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
 
     @functools.partial(jax.jit, static_argnames=())
@@ -125,7 +164,8 @@ def make_generator(
 
 
 def generate(model, params, prompt, max_new: int, max_len: int | None = None,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+             rng=None):
     """One-shot convenience over :func:`make_generator` (compiles per call —
     build the generator once for repeated use)."""
     prompt = jnp.asarray(prompt)
@@ -133,6 +173,6 @@ def generate(model, params, prompt, max_new: int, max_len: int | None = None,
         prompt = prompt[None, :]
     if max_len is None:
         max_len = int(prompt.shape[1]) + max_new
-    return make_generator(model, max_len, max_new, temperature)(
+    return make_generator(model, max_len, max_new, temperature, top_k, top_p)(
         params, prompt, rng=rng
     )

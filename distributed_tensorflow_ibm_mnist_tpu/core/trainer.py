@@ -137,6 +137,12 @@ class Trainer:
         )
         if config.dcn_dp < 1:
             raise ValueError(f"dcn_dp must be >= 1, got {config.dcn_dp}")
+        if config.dcn_dp > 1 and mesh is not None:
+            raise ValueError(
+                "dcn_dp with an explicit mesh is ambiguous — build the "
+                "multislice mesh yourself via make_mesh(..., dcn_dp=N) and "
+                "leave config.dcn_dp at 1, or pass no mesh"
+            )
         # dcn_dp > 1 forces the mesh build so its multislice validation
         # runs (a dp=1 run would otherwise silently ignore the request)
         if mesh is None and (self.dp > 1 or self.tp > 1 or self.sp > 1
@@ -812,7 +818,8 @@ class Trainer:
             self.state = state0
 
     def generate(self, prompt, max_new: int, max_len: int | None = None,
-                 temperature: float = 0.0, rng=None):
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 rng=None):
         """Autoregressive decode from this run's trained weights
         (core/generate.py; causal-LM family only).
 
@@ -846,7 +853,8 @@ class Trainer:
                           **clean_kwargs)
         params = jax.device_put(jax.device_get(self.state.params))
         return generate(model, params, prompt, max_new,
-                        max_len=max_len, temperature=temperature, rng=rng)
+                        max_len=max_len, temperature=temperature,
+                        top_k=top_k, top_p=top_p, rng=rng)
 
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))

@@ -79,6 +79,8 @@ def make_mesh(
     the virtual CPU mesh) refuse it with a clear error rather than
     silently degrading to a flat mesh.
     """
+    if dcn_dp < 1:
+        raise ValueError(f"dcn_dp must be >= 1, got {dcn_dp}")
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if dp is None or dp == 0:

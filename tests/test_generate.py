@@ -103,6 +103,77 @@ def test_decode_past_trained_length_with_rope():
     assert out.shape == (1, 32)
 
 
+def test_logit_filters():
+    """top-k keeps exactly k candidates; top-p keeps the smallest nucleus
+    (argmax always survives); both leave kept logits untouched."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.generate import _filter_logits
+
+    logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0, -1.0]])
+    neg = float(jnp.finfo(jnp.float32).min)
+
+    k2 = np.asarray(_filter_logits(logits, top_k=2, top_p=0.0))[0]
+    np.testing.assert_allclose(k2[[0, 2]], [3.0, 2.0])
+    assert (k2[[1, 3, 4]] == neg).all()
+
+    # softmax of [3,1,2,0,-1] ~ [.63,.085,.23,.03,.01]: nucleus at p=.7
+    # keeps {3.0, 2.0}
+    p7 = np.asarray(_filter_logits(logits, top_k=0, top_p=0.7))[0]
+    np.testing.assert_allclose(p7[[0, 2]], [3.0, 2.0])
+    assert (p7[[1, 3, 4]] == neg).all()
+
+    # tiny p: the argmax always survives
+    p_tiny = np.asarray(_filter_logits(logits, top_k=0, top_p=1e-6))[0]
+    assert p_tiny[0] == 3.0 and (p_tiny[1:] == neg).all()
+
+
+def test_sampling_with_filters_stays_in_support():
+    """Filtered sampling only ever emits tokens the filter kept — checked
+    for real top_k>1 and top_p sets against the model's own logits, plus
+    the degenerate top_k=1 == greedy identity."""
+    model, params = _model_and_params(seed=7)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+
+    # analytic support at the first sampled position
+    logits = np.asarray(model.apply({"params": params}, prompt))[0, -1]
+    top3 = set(np.argsort(logits)[::-1][:3].tolist())
+    probs = np.exp(logits - logits.max())
+    probs /= probs.sum()
+    order = np.argsort(probs)[::-1]
+    nucleus, mass = set(), 0.0
+    for tok in order:  # smallest prefix reaching p=0.5, argmax always in
+        nucleus.add(int(tok))
+        mass += probs[tok]
+        if mass >= 0.5:
+            break
+
+    gen_k = make_generator(model, max_len=16, max_new=1, temperature=2.0,
+                           top_k=3)
+    gen_p = make_generator(model, max_len=16, max_new=1, temperature=2.0,
+                           top_p=0.5)
+    for seed in range(24):
+        first_k = int(gen_k(params, prompt, rng=jax.random.PRNGKey(seed))[0, -1])
+        assert first_k in top3, (first_k, top3)
+        first_p = int(gen_p(params, prompt, rng=jax.random.PRNGKey(seed))[0, -1])
+        assert first_p in nucleus, (first_p, nucleus)
+
+    # top_k=1 at any temperature is argmax: must equal greedy decode
+    gen1 = make_generator(model, max_len=32, max_new=16, temperature=1.5,
+                          top_k=1)
+    greedy = make_generator(model, max_len=32, max_new=16)(params, prompt)
+    sampled = gen1(params, prompt, rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_filter_validation():
+    model, _ = _model_and_params(seed=8)
+    with pytest.raises(ValueError, match="temperature"):
+        make_generator(model, max_len=16, max_new=4, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generator(model, max_len=16, max_new=4, temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        make_generator(model, max_len=16, max_new=4, temperature=1.0, top_k=-2)
+
+
 def test_flash_prefill_cache_matches_decode_prefill():
     """make_generator prefills through the NORMAL forward (flash-friendly,
     no O(P*max_len) score matrix) and assembles the cache from sown K/V —
