@@ -113,8 +113,10 @@ def make_generator(
     def pick(logits, rng):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = _filter_logits(logits, top_k, top_p)
-        return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
+        # temperature BEFORE the filters (the standard order): the nucleus
+        # must be p mass of the distribution actually being sampled
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(rng, logits).astype(jnp.int32)
 
     @functools.partial(jax.jit, static_argnames=())
     def gen(params, prompt, rng=None):

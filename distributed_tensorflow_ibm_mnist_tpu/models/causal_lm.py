@@ -60,6 +60,10 @@ class CausalLM(nn.Module):
     #   length into the checkpoint; kept for ablation) | 'none'
     sow_kv: bool = False  # sow per-block K/V on the normal forward (the
     #   flash-prefill capture; core/generate.py clones the model with this)
+    tie_embeddings: bool = False  # share the token embedding with the
+    #   output head (logits = x @ embed^T): V*dim fewer params, the
+    #   standard small-LM regularizer.  The Megatron rule's feature-dim
+    #   embedding sharding doubles as the head's row-parallel layout.
     moe_every: int = 0
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
@@ -86,9 +90,9 @@ class CausalLM(nn.Module):
         if decode and (self.pp_stages > 0 or self.moe_every > 0):
             raise ValueError("decode mode supports the plain block stack "
                              "(no pp_stages, no MoE)")
-        x = nn.Embed(self.num_classes, self.dim, dtype=self.dtype, name="embed")(
-            tokens.astype(jnp.int32)
-        )
+        embed = nn.Embed(self.num_classes, self.dim, dtype=self.dtype,
+                         name="embed")
+        x = embed(tokens.astype(jnp.int32))
         if self.pos == "learned":
             pos = self.param("pos_embed", nn.initializers.normal(0.02), (1, s, self.dim))
             x = x + pos.astype(self.dtype)
@@ -132,7 +136,10 @@ class CausalLM(nn.Module):
                 name="pipe_blocks",
             )(x, train=train)
             x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
-            x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+            if self.tie_embeddings:
+                x = embed.attend(x)  # logits = x @ embed^T, weights shared
+            else:
+                x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
             return x.astype(jnp.float32)
         block_cls = (
             nn.remat(TransformerBlock, static_argnums=(2,))
@@ -155,5 +162,8 @@ class CausalLM(nn.Module):
                 window=self.window, dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
-        x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
+        if self.tie_embeddings:
+            x = embed.attend(x)  # logits = x @ embed^T, weights shared
+        else:
+            x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
         return x.astype(jnp.float32)

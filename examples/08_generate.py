@@ -48,10 +48,11 @@ def main():
     print(f"prompt {prompt.shape} -> generated {out.shape}")
     print("first generated row:", np.asarray(out[0, 8:24]))
 
-    # Sampled decode: temperature + rng
+    # Sampled decode: temperature + nucleus/top-k filters + rng
     import jax
 
     sampled = trainer.generate(prompt, max_new=16, temperature=0.8,
+                               top_p=0.9, top_k=8,
                                rng=jax.random.PRNGKey(0))
     print("sampled row:       ", np.asarray(sampled[0, 8:24]))
 

@@ -217,3 +217,47 @@ def test_causal_lm_fsdp_and_ulysses(eight_devices):
     ))
     s = t_u.fit()
     assert np.isfinite(s["best_test_accuracy"])
+
+
+def test_tied_embeddings():
+    """tie_embeddings shares the embedding with the head: no logits param,
+    vocab*dim fewer params, logits == x @ embed^T, and it trains + decodes."""
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+
+    kw = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+    tied = get_model("causal_lm", tie_embeddings=True, **kw)
+    untied = get_model("causal_lm", **kw)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    p_t = tied.init(jax.random.PRNGKey(0), toks)["params"]
+    p_u = untied.init(jax.random.PRNGKey(0), toks)["params"]
+    assert "logits" not in p_t and "logits" in p_u
+    n_t = sum(x.size for x in jax.tree.leaves(p_t))
+    n_u = sum(x.size for x in jax.tree.leaves(p_u))
+    assert n_u - n_t == 16 * 32 + 16  # head kernel + bias gone
+
+    # end-to-end: trains on retrieval and decodes (flash prefill + cache)
+    cfg = RunConfig(
+        name="tied", epochs=8, eval_every=8,
+        **{**BASE, "n_train": 2048,
+           "model_kwargs": {**BASE["model_kwargs"], "tie_embeddings": True}},
+    )
+    t = Trainer(cfg)
+    t.fit()
+    assert t.history[-1]["train_loss"] < 2.0
+    out = t.generate(jnp.asarray([[3, 1, 4]], jnp.int32), max_new=5)
+    assert out.shape == (1, 8)
+
+
+def test_tied_embeddings_tp_shards(eight_devices):
+    """Tied head under TP: the embedding's feature-dim 'model' sharding
+    doubles as the head's row-parallel layout; the run trains."""
+    cfg = RunConfig(
+        name="tied_tp", epochs=1, dp=4, tp=2,
+        **{**BASE,
+           "model_kwargs": {**BASE["model_kwargs"], "tie_embeddings": True}},
+    )
+    t = Trainer(cfg)
+    emb = t.state.params["embed"]["embedding"]
+    assert tuple(emb.sharding.spec) == (None, "model")
+    s = t.fit()
+    assert np.isfinite(s["best_test_accuracy"])

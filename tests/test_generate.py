@@ -133,10 +133,12 @@ def test_sampling_with_filters_stays_in_support():
     model, params = _model_and_params(seed=7)
     prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
 
-    # analytic support at the first sampled position
+    # analytic support at the first sampled position; the nucleus is taken
+    # on the TEMPERED distribution (temperature applies before the filter)
     logits = np.asarray(model.apply({"params": params}, prompt))[0, -1]
     top3 = set(np.argsort(logits)[::-1][:3].tolist())
-    probs = np.exp(logits - logits.max())
+    tempered = logits / 2.0
+    probs = np.exp(tempered - tempered.max())
     probs /= probs.sum()
     order = np.argsort(probs)[::-1]
     nucleus, mass = set(), 0.0
