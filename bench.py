@@ -46,8 +46,15 @@ BENCH_OVERRIDES: dict = {
 }
 
 
-def _cache_prewarmed(cache_dir: str | None) -> bool:
-    """Whether the persistent compile cache already holds entries."""
+def _cache_dir_nonempty(cache_dir: str | None) -> bool:
+    """Whether the persistent compile cache holds ANY entries.
+
+    Deliberately named for what it checks: entries may belong to a
+    different program, so this is provenance for phase 1's
+    first-epoch figure, NOT proof phase 1 compiled warm — the warm/cold
+    compile figures are therefore each measured in their own subprocess
+    (r3 advisor: a nonempty dir without THIS program's entries would
+    otherwise report a cold compile as compile_s_warm)."""
     import os
 
     if not cache_dir or not os.path.isdir(cache_dir):
@@ -121,29 +128,24 @@ def main() -> None:
     # in 2 epochs.
     cfg = get_preset("mnist_lenet_1chip").replace(**BENCH_OVERRIDES)
     cache_dir = resolve_compile_cache_dir(cfg.compile_cache_dir)
-    prewarmed = _cache_prewarmed(cache_dir)
+    prewarmed = _cache_dir_nonempty(cache_dir)
     trainer = Trainer(cfg)
 
     # Phase 1 — steady-state throughput + MFU (public API; also warms the
     # epoch-runner compile cache and restores the fresh state afterwards).
-    # This process started fresh, so its compile_and_first_epoch_s IS the
-    # honest figure for the cache condition found on disk: cold when the
-    # persistent cache started empty, warm when it was prewarmed.
     tput = trainer.measure_throughput(epochs=10)
 
-    # Phase 1b — the OTHER compile condition, measured in a fresh
-    # subprocess (see _compile_s_in_subprocess for why in-process is
-    # dishonest in both directions).
-    if prewarmed:
-        compile_s_warm = tput["compile_and_first_epoch_s"]
-        compile_s_cold = _compile_s_in_subprocess(use_cache=False)
-    else:
-        compile_s_cold = tput["compile_and_first_epoch_s"]
-        # phase 1 just populated the cache (if one resolved); a fresh
-        # process now hits it — with no cache dir a "warm" run is a myth
-        compile_s_warm = (
-            _compile_s_in_subprocess(use_cache=True) if cache_dir else None
-        )
+    # Phase 1b — BOTH compile conditions, each in its own fresh subprocess
+    # (see _compile_s_in_subprocess for why in-process is dishonest in both
+    # directions).  Phase 1's own first-epoch figure is not used for
+    # either: a nonempty cache dir doesn't prove it holds THIS program's
+    # entries (r3 advisor), but after phase 1 the cache certainly does, so
+    # the use_cache=True subprocess really deserializes and the
+    # use_cache=False one really recompiles.
+    compile_s_cold = _compile_s_in_subprocess(use_cache=False)
+    compile_s_warm = (
+        _compile_s_in_subprocess(use_cache=True) if cache_dir else None
+    )
 
     # Warm the eval compile outside phase 2's timed region (same shapes).
     trainer.evaluate()
@@ -193,8 +195,10 @@ def main() -> None:
         "time_to_target_s_excl_compile": (
             round(wall_excl_compile, 3) if summary["time_to_target_s"] else None
         ),
-        # both compile conditions, each measured THIS run (see phase 1b);
-        # compile_cache_prewarmed records which condition phase 1 ran under
+        # both compile conditions, each measured in its own fresh
+        # subprocess THIS run (see phase 1b); compile_cache_prewarmed
+        # records whether the cache dir held any entries (of any program)
+        # when this process started — provenance, not a warmth claim
         "time_to_target_s_incl_compile_cold": (
             round(wall_excl_compile + compile_s_cold, 3)
             if summary["time_to_target_s"] and compile_s_cold is not None

@@ -46,8 +46,11 @@ def make_loss_fn(
     """Cross-entropy loss closure over a flax model.
 
     Returns ``loss_fn(params, batch_stats, batch, dropout_rng, train)``
-    -> ``(loss, (new_batch_stats, logits))``.  ``label_smoothing`` applies to
-    the training loss only (eval always reports unsmoothed cross-entropy).
+    -> ``(loss, (new_batch_stats, logits, moe_dropped_frac))`` where the
+    last aux element is the mean MoE capacity-dropped fraction, or None
+    (statically) for models with no MoE blocks.  ``label_smoothing``
+    applies to the training loss only (eval always reports unsmoothed
+    cross-entropy).
     ``fused_xent`` routes the unsmoothed loss through the Pallas fused
     softmax-xent kernel (ops/xent.py) instead of the XLA-emitted optax op.
     ``remat`` wraps the forward in ``jax.checkpoint`` — activations are
@@ -71,19 +74,28 @@ def make_loss_fn(
         kwargs: dict[str, Any] = {"train": train}
         if train:
             kwargs["rngs"] = {"dropout": dropout_rng}
-        # "losses" collects sown auxiliary losses (MoE load-balancing); it is
-        # empty for non-MoE models at zero cost
-        mutable = ["losses"] + (["batch_stats"] if has_stats and train else [])
+        # "losses" collects sown auxiliary losses (MoE load-balancing),
+        # "zlosses" pre-weighted router z-losses, "moe_stats" routing
+        # observability (capacity-dropped fraction); all empty for non-MoE
+        # models at zero cost
+        mutable = ["losses", "zlosses", "moe_stats"] + (
+            ["batch_stats"] if has_stats and train else [])
         logits, updated = model.apply(variables, _as_input(image), mutable=mutable, **kwargs)
         new_stats = updated.get("batch_stats", batch_stats)
         aux = sum(jnp.sum(v) for v in jax.tree.leaves(updated.get("losses", {})))
-        return logits, new_stats, jnp.asarray(aux, jnp.float32)
+        zloss = sum(jnp.sum(v) for v in jax.tree.leaves(updated.get("zlosses", {})))
+        drops = jax.tree.leaves(updated.get("moe_stats", {}))
+        # mean over MoE blocks; None (STATIC: no MoE in the model) keeps
+        # the metric out of non-MoE runs' records entirely
+        drop = sum(drops) / len(drops) if drops else None
+        return (logits, new_stats, jnp.asarray(aux, jnp.float32),
+                jnp.asarray(zloss, jnp.float32), drop)
 
     if remat:
         forward = jax.checkpoint(forward, static_argnums=(4,))
 
     def loss_fn(params, batch_stats, batch: Batch, dropout_rng, train: bool = True):
-        logits, new_stats, aux = forward(
+        logits, new_stats, aux, zloss, drop = forward(
             params, batch_stats, batch["image"], dropout_rng, train
         )
         if train and label_smoothing > 0.0:
@@ -97,8 +109,10 @@ def make_loss_fn(
         else:
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]).mean()
         if train:
-            loss = loss + moe_aux_weight * aux
-        return loss, (new_stats, logits)
+            # z-losses are sown pre-weighted (MoEBlock.z_weight), so they
+            # add at 1.0 — independent of the load-balancing weight
+            loss = loss + moe_aux_weight * aux + zloss
+        return loss, (new_stats, logits, drop)
 
     return loss_fn
 
@@ -131,7 +145,7 @@ def make_train_step(
             dropout_rng = jax.random.fold_in(dropout_rng, jax.lax.axis_index(axis_name))
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         if grad_accum == 1:
-            (loss, (new_stats, logits)), grads = grad_fn(
+            (loss, (new_stats, logits, drop)), grads = grad_fn(
                 state.params, state.batch_stats, batch, dropout_rng
             )
             accuracy = jnp.mean(logits.argmax(-1) == batch["label"])
@@ -146,23 +160,28 @@ def make_train_step(
             def accum(carry, xs):
                 stats, g_sum, loss_sum, acc_sum, i = carry
                 rng_i = jax.random.fold_in(dropout_rng, i)
-                (l, (stats, logits)), g = grad_fn(state.params, stats, xs, rng_i)
+                (l, (stats, logits, d)), g = grad_fn(state.params, stats, xs, rng_i)
                 a = jnp.mean(logits.argmax(-1) == xs["label"])
                 g_sum = jax.tree.map(jnp.add, g_sum, g)
-                return (stats, g_sum, loss_sum + l, acc_sum + a, i + 1), None
+                return (stats, g_sum, loss_sum + l, acc_sum + a, i + 1), d
 
             g0 = jax.tree.map(jnp.zeros_like, state.params)
             zero = jnp.zeros((), jnp.float32)
-            (new_stats, g_sum, loss_sum, acc_sum, _), _ = jax.lax.scan(
+            # ys carries the per-micro drop fraction (None — an empty
+            # pytree — for non-MoE models, statically)
+            (new_stats, g_sum, loss_sum, acc_sum, _), drops = jax.lax.scan(
                 accum, (state.batch_stats, g0, zero, zero, jnp.zeros((), jnp.int32)), micro
             )
             grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
             loss = loss_sum / grad_accum
             accuracy = acc_sum / grad_accum
+            drop = None if drops is None else jnp.mean(drops)
         if axis_name is not None:
             # The NCCL-all-reduce replacement: one fused cross-replica mean
             # over the ICI mesh axis, inside the compiled step.
             grads, loss, accuracy = jax.lax.pmean((grads, loss, accuracy), axis_name)
+            if drop is not None:
+                drop = jax.lax.pmean(drop, axis_name)
             if state.batch_stats:
                 new_stats = jax.lax.pmean(new_stats, axis_name)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
@@ -173,7 +192,12 @@ def make_train_step(
             batch_stats=new_stats,
             opt_state=new_opt_state,
         )
-        return new_state, {"loss": loss, "accuracy": accuracy}
+        metrics = {"loss": loss, "accuracy": accuracy}
+        if drop is not None:
+            # routing observability (VERDICT.md r3 item 5): capacity
+            # overflow shows up as a metric, not as silent quality loss
+            metrics["moe_dropped_frac"] = drop
+        return new_state, metrics
 
     return train_step
 
@@ -289,7 +313,7 @@ def make_eval_fn(model, batch_size: int = 2000, n_valid: int | None = None, mesh
 
         def body(carry, xs):
             imgs, labs, v = xs
-            loss, (_, logits) = loss_fn(
+            loss, (_, logits, _) = loss_fn(
                 state.params, state.batch_stats, {"image": imgs, "label": labs},
                 jax.random.PRNGKey(0), train=False,
             )

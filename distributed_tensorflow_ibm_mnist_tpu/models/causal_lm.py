@@ -68,6 +68,7 @@ class CausalLM(nn.Module):
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1  # experts per token: 1 = Switch, >1 = GShard top-k
+    moe_z_weight: float = 0.0  # router z-loss coefficient (ST-MoE; 0 = off)
     moe_fn: Callable | None = None
     pp_stages: int = 0  # >0: stack blocks for the GPipe island (see the
     #                     ViT's StackedBlocks; params shardable over 'pipe')
@@ -157,7 +158,7 @@ class CausalLM(nn.Module):
                 dropout=self.dropout, attn_fn=attn_fn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
-                moe_top_k=self.moe_top_k,
+                moe_top_k=self.moe_top_k, moe_z_weight=self.moe_z_weight,
                 moe_fn=self.moe_fn, rope=rope, sow_kv=self.sow_kv,
                 window=self.window, dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)

@@ -44,17 +44,26 @@ def apply_rope(x: jnp.ndarray, theta: float = 10000.0, offset=0) -> jnp.ndarray:
 
     ``offset`` shifts the positions (may be a traced int32 scalar): the
     KV-cache decode path rotates the current chunk at its absolute
-    position ``cache_index + arange(s)``.
+    position ``cache_index + arange(s)``.  A (B,)-shaped ``offset`` gives
+    each batch row its own absolute position — the ragged-prompt decode
+    path, where row b's cursor sits at its own prompt length.
     """
     b, s, h, d = x.shape
     if d % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {d}")
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = jnp.asarray(offset, jnp.float32) + jnp.arange(s, dtype=jnp.float32)
-    ang = pos[:, None] * freqs[None, :]  # (S, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.float32)
+    if off.ndim == 0:
+        pos = off + jnp.arange(s, dtype=jnp.float32)
+        ang = pos[:, None] * freqs[None, :]  # (S, half)
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:  # (B,) per-row offsets
+        pos = off[:, None] + jnp.arange(s, dtype=jnp.float32)[None, :]
+        ang = pos[..., None] * freqs  # (B, S, half)
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -91,6 +100,7 @@ class TransformerBlock(nn.Module):
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1  # experts per token: 1 = Switch, >1 = GShard top-k
+    moe_z_weight: float = 0.0  # router z-loss coefficient (ST-MoE; 0 = off)
     moe_fn: Callable | None = None  # expert-parallel dispatch island (make_moe_dispatch)
     rope: bool = False  # rotary position embedding on q/k (apply_rope) —
     #   set by models whose pos="rope"; runs BEFORE attn_fn so sp islands
@@ -155,7 +165,7 @@ class TransformerBlock(nn.Module):
             h = MoEBlock(
                 dim=self.dim, n_experts=self.n_experts, hidden_mult=self.mlp_ratio,
                 capacity_factor=self.moe_capacity_factor, top_k=self.moe_top_k,
-                ep_fn=self.moe_fn, name="moe",
+                z_weight=self.moe_z_weight, ep_fn=self.moe_fn, name="moe",
             )(h, train=train)
         else:
             h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype, name="dense_0")(h)
@@ -168,15 +178,33 @@ class TransformerBlock(nn.Module):
     def _decode_attention(self, q, k, v, max_len: int):
         """Incremental (KV-cache) attention for autoregressive decoding.
 
-        Appends this call's K/V at the running ``cache_index`` (a flax
-        ``cache`` variable collection, mutated via ``mutable=["cache"]``)
-        and attends each query causally over the filled prefix.  Handles
-        S >= 1, so one call prefills the whole prompt and subsequent S=1
-        calls decode — the core/generate.py contract.  The sp/ring
-        ``attn_fn`` islands and the flash kernel are training/prefill
-        machinery; decode is bandwidth-bound gather-attend over the cache,
-        which XLA handles directly (no custom kernel needed at this scale).
-        RoPE rotates at absolute positions ``cache_index + arange(S)``.
+        Appends this call's K/V at the running per-row ``cache_index`` (a
+        (B,) int32 cursor in the flax ``cache`` collection, mutated via
+        ``mutable=["cache"]``) and attends each query causally over its
+        row's filled prefix.  Handles S >= 1, so one call prefills a whole
+        prompt and subsequent S=1 calls decode — the core/generate.py
+        contract.  The cursor being per-row is what makes RAGGED prompts
+        work: after a right-padded prefill each row's cursor starts at its
+        own prompt length, new K/V land at per-row positions (vmapped
+        ``dynamic_update_slice``), RoPE rotates at per-row absolute
+        offsets, and the causal mask ``k_pos <= cursor`` keeps every row
+        from seeing the pad garbage beyond its own prefix.
+
+        Dtype policy matches the flash kernel (ops/flash_attention.py):
+        native-dtype MXU operands with f32 accumulation
+        (``preferred_element_type``) — decode is cache-bandwidth-bound, so
+        upcasting the whole (B, max_len, H_kv, D) cache to f32 per step
+        (the round-3 form) doubled the bytes read of the dominant stream.
+        Softmax stays f32.
+
+        The sp/ring ``attn_fn`` islands and the flash kernel are
+        training/prefill machinery; decode is bandwidth-bound
+        gather-attend over the cache, which XLA handles directly (no
+        custom kernel needed at this scale).  Note each step scores
+        against the FULL max_len cache — O(max_len) per step even when
+        ``window`` masks most of it; acceptable at zoo scale, gather a
+        W-sized slice if a long-max_len windowed serving path ever needs
+        it.
         """
         if max_len <= 0:
             raise ValueError("decode=True needs max_len > 0 (the KV-cache size)")
@@ -187,42 +215,49 @@ class TransformerBlock(nn.Module):
         cache_v = self.variable(
             "cache", "v", lambda: jnp.zeros((b, max_len, hkv, d), self.dtype))
         idx_var = self.variable(
-            "cache", "index", lambda: jnp.zeros((), jnp.int32))
-        idx = idx_var.value
+            "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
+        idx = idx_var.value  # (B,) per-row decode cursor
         if self.rope:
             q = apply_rope(q, offset=idx)
             k = apply_rope(k, offset=idx)
         import jax
 
-        cache_k.value = jax.lax.dynamic_update_slice(
-            cache_k.value, k.astype(cache_k.value.dtype), (0, idx, 0, 0))
-        cache_v.value = jax.lax.dynamic_update_slice(
-            cache_v.value, v.astype(cache_v.value.dtype), (0, idx, 0, 0))
+        row_update = jax.vmap(
+            lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0)))
+        cache_k.value = row_update(
+            cache_k.value, k.astype(cache_k.value.dtype), idx)
+        cache_v.value = row_update(
+            cache_v.value, v.astype(cache_v.value.dtype), idx)
         idx_var.value = idx + s
 
-        q32 = q.astype(jnp.float32) * (d ** -0.5)
-        k32 = cache_k.value.astype(jnp.float32)
-        v32 = cache_v.value.astype(jnp.float32)
+        kc, vc = cache_k.value, cache_v.value
         k_pos = jnp.arange(max_len)
-        q_pos = idx + jnp.arange(s)
-        mask = k_pos[None, :] <= q_pos[:, None]  # (S, max_len), causal prefix
+        q_pos = idx[:, None] + jnp.arange(s)  # (B, S) absolute positions
+        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, S, max_len)
         if self.window:
-            mask &= k_pos[None, :] > q_pos[:, None] - self.window
+            mask &= k_pos[None, None, :] > q_pos[:, :, None] - self.window
+        scale = d ** -0.5
         if hkv != h:
             # grouped einsum against the hkv-sized cache — no materialized
             # repeat (the smaller cache bandwidth IS the GQA decode win)
-            qg = q32.reshape(b, s, hkv, h // hkv, d)
-            scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k32)
-            scores = jnp.where(mask[None, None, None], scores, -1e30)
+            qg = q.reshape(b, s, hkv, h // hkv, d)
+            scores = jnp.einsum(
+                "bqhgd,bkhd->bhgqk", qg, kc,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask[:, None, None], scores, -1e30)
+            p = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum(
-                "bhgqk,bkhd->bqhgd", jax.nn.softmax(scores, axis=-1), v32
-            ).reshape(b, s, h, d)
+                "bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc,
+                preferred_element_type=jnp.float32).reshape(b, s, h, d)
         else:
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k32)
-            scores = jnp.where(mask[None, None], scores, -1e30)
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kc,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(mask[:, None], scores, -1e30)
+            p = jax.nn.softmax(scores, axis=-1)
             out = jnp.einsum(
-                "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v32
-            )
+                "bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
+                preferred_element_type=jnp.float32)
         return out.astype(self.dtype)
 
 
@@ -322,6 +357,7 @@ class VisionTransformer(nn.Module):
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
+    moe_z_weight: float = 0.0  # router z-loss coefficient (0 = off)
     moe_fn: Callable | None = None
     pp_stages: int = 0  # >0: stack blocks (n_stages, per_stage, ...) for the
     #                     GPipe island — params shardable over 'pipe'
@@ -382,7 +418,7 @@ class VisionTransformer(nn.Module):
                 dropout=self.dropout, attn_fn=self.attn_fn, attn=self.attn,
                 use_moe=self.moe_every > 0 and (i + 1) % self.moe_every == 0,
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
-                moe_top_k=self.moe_top_k,
+                moe_top_k=self.moe_top_k, moe_z_weight=self.moe_z_weight,
                 moe_fn=self.moe_fn, dtype=self.dtype, name=f"block_{i}",
             )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)

@@ -8,10 +8,13 @@ VMEM while online-softmax (fwd) / recompute (bwd) accumulators live in
 VMEM scratch across the innermost grid steps.  The (S x S) score matrix
 never exists in HBM and VMEM stays O(tile), so sequence length scales to
 HBM capacity (vs the O(S) VMEM of a whole-row design that tops out around
-S~4k on v5e).  The backward pass is the standard flash recompute scheme:
-probabilities are rebuilt blockwise from the saved row logsumexp, one
-kernel accumulating dK/dV over q-tiles and one accumulating dQ over
-k-tiles.
+S~4k on v5e).  The backward pass is the standard flash recompute scheme —
+probabilities rebuilt blockwise from the saved row logsumexp — fused into
+ONE grid walk producing dQ, dK and dV together when dQ's full-row VMEM
+accumulator fits (the round-4 rewrite; the profile priced the old
+two-kernel scheme's double scores/p/ds recompute at 75% of attention
+time), with the two-kernel scheme (dK/dV over q-tiles, then dQ over
+k-tiles) as the long-row fallback.
 
 MXU dtype policy (the round-3 rewrite; VERDICT.md r2 item 1): every
 matmul runs with the INPUT dtype on the MXU and float32 accumulation
@@ -65,6 +68,15 @@ _NEG = -1e30
 # inside the 16 MB scoped-VMEM budget with double-buffered operands.
 _BLOCK_Q = 512
 _BLOCK_K = 1024
+
+# Fused-backward gate: the one-walk backward keeps dQ's whole (padded) row
+# in VMEM — an f32 accumulator plus the output block in the input dtype,
+# S_pad * D * (4 + itemsize) bytes.  6 MB leaves ~10 MB of the 16 MB
+# scoped-VMEM budget for the double-buffered tile operands and the f32
+# score/p/ds intermediates at the default 512x1024 tiles (S=8192, D=64
+# bf16 needs 3 MB and fits; rows past ~1M elements fall back to the
+# two-kernel scheme).
+_FUSED_DQ_VMEM_BUDGET = 6 * 1024 * 1024
 
 
 def _on_tpu() -> bool:
@@ -199,6 +211,74 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
+def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, dq_sc, *,
+                      sm_scale, block_q, block_k, n_q, n_k, s_real, causal,
+                      window):
+    """The whole flash backward in ONE grid walk (VERDICT.md r3 item 2).
+
+    The two-kernel scheme (dK/dV then dQ below, kept as the fallback)
+    rebuilds ``scores``/``p``/``ds`` from scratch in each kernel — 7
+    matmuls per live tile pair where 5 are semantically needed, plus a
+    second full DMA sweep of q/k/v/do/lse/delta.  This kernel walks the
+    dK/dV layout — grid (bh, k-tile, q-tile), q innermost — computes the
+    recompute chain ONCE per live tile, and accumulates all three grads:
+    dK/dV in per-k-tile scratch as before, dQ into a FULL-ROW (n_q,
+    block_q, D) f32 VMEM scratch indexed by the q-tile id (each q-row
+    block collects one contribution per k-tile; the row buffer is what
+    makes cross-k accumulation possible without revisiting HBM blocks,
+    and is why this kernel is gated on S*D fitting the VMEM budget — see
+    ``_FUSED_DQ_VMEM_BUDGET``).  dQ flushes to its (1, S_pad, D) output
+    block once per bh row, at the row's final grid step.
+    """
+    ji, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when((ji == 0) & (qi == 0))
+    def _init_dq():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    def _compute():
+        k = k_ref[0]   # (Bk, D), input dtype
+        v = v_ref[0]
+        q = q_ref[0]   # (Bq, D)
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        bq, bk = q.shape[0], k.shape[0]
+        scores = _dot(q, k, ((1,), (1,))) * sm_scale  # (Bq, Bk) f32
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ji * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (k_pos < s_real) & (q_pos < s_real)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+            if window:
+                mask = mask & (k_pos > q_pos - window)
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed ONCE
+        dv_sc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        dp = _dot(do, v, ((1,), (1,)))  # (Bq, Bk) f32
+        ds = p * (dp - delta) * sm_scale
+        dk_sc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
+        dq_sc[qi] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
+
+    # causal skip: see the gating note in _fwd_kernel (dead steps skip the
+    # compute AND the clamped q-side index maps elide their DMAs)
+    _run_live_tiles(causal, qi, ji, block_q, block_k, _compute, window)
+
+    @pl.when(qi == n_q - 1)
+    def _flush_dkv():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+    @pl.when((ji == n_k - 1) & (qi == n_q - 1))
+    def _flush_dq():
+        dq_ref[0] = dq_sc[...].reshape(dq_ref.shape[1:]).astype(dq_ref.dtype)
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
                *, sm_scale, block_q, block_k, n_k, s_real, causal, window):
     # grid (bh, q-tile, k-tile), k innermost; scratch accumulates dQ.
@@ -331,6 +411,20 @@ def _grid_params(interpret):
     }
 
 
+def _fused_grid_params(interpret):
+    # the fused backward accumulates dQ across BOTH non-leading grid dims
+    # (every (k-tile, q-tile) step adds into the full-row scratch), so
+    # only bh may be parallelized across cores
+    if interpret:
+        return {"interpret": True}
+    return {
+        "interpret": False,
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+    }
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, interpret, window):
     out, _ = _flash_fwd(q, k, v, causal, interpret, window)
@@ -405,6 +499,55 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret, window=0):
     n_k = sp // block_k
     sm_scale = d**-0.5
 
+    def from_bh(x, n_heads):
+        return x[:, :s, :].reshape(b, n_heads, s, d).transpose(0, 2, 1, 3)
+
+    def from_bh_grouped(x):
+        x = x[:, :s, :].reshape(b, h, s, d)
+        if hkv != h:
+            x = x.reshape(b, hkv, h // hkv, s, d).sum(axis=2)
+        return x.transpose(0, 2, 1, 3)
+
+    # FUSED path (VERDICT.md r3 item 2): one grid walk produces dQ, dK and
+    # dV — one scores/p/ds recompute instead of two (5 matmuls per live
+    # tile, not 7) and one DMA sweep of the operands instead of two.  dQ
+    # accumulates in a full-row f32 VMEM scratch, so the path is gated on
+    # that buffer (plus dQ's whole-row output block) fitting alongside the
+    # tile operands; longer rows fall back to the two-kernel scheme below.
+    fused_row_bytes = sp * d * (4 + jnp.dtype(q.dtype).itemsize)
+    if fused_row_bytes <= _FUSED_DQ_VMEM_BUDGET:
+        dq_p, dk_p, dv_p = pl.pallas_call(
+            partial(_fused_bwd_kernel, sm_scale=sm_scale, block_q=block_q,
+                    block_k=block_k, n_q=n_q, n_k=n_k, s_real=s,
+                    causal=causal, window=window),
+            grid=(bh, n_k, n_q),
+            in_specs=[
+                _q_side_spec(block_q, d, block_k, causal, window),   # q
+                _kv_spec(block_k, d, h, hkv, k_axis=1),              # k
+                _kv_spec(block_k, d, h, hkv, k_axis=1),              # v
+                _q_side_spec(block_q, d, block_k, causal, window),   # do
+                _q_side_spec(block_q, 1, block_k, causal, window),   # lse
+                _q_side_spec(block_q, 1, block_k, causal, window),   # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, sp, d), lambda b_, j, i: (b_, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sp, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sp, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sp, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),       # dk tile
+                pltpu.VMEM((block_k, d), jnp.float32),       # dv tile
+                pltpu.VMEM((n_q, block_q, d), jnp.float32),  # dq full row
+            ],
+            **_fused_grid_params(interpret),
+        )(qp, kp, vp, gp, lse, delta)
+        return from_bh(dq_p, h), from_bh_grouped(dk_p), from_bh_grouped(dv_p)
+
     # dK/dV are produced PER Q-HEAD (shape B*H like q) and group-reduced
     # below: under GQA one kv-head serves h/hkv q-heads, and accumulating
     # across them inside the kernel would race the "parallel" grid dim.
@@ -455,15 +598,6 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret, window=0):
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],  # dq
         **_grid_params(interpret),
     )(qp, kp, vp, gp, lse, delta)
-
-    def from_bh(x, n_heads):
-        return x[:, :s, :].reshape(b, n_heads, s, d).transpose(0, 2, 1, 3)
-
-    def from_bh_grouped(x):
-        x = x[:, :s, :].reshape(b, h, s, d)
-        if hkv != h:
-            x = x.reshape(b, hkv, h // hkv, s, d).sum(axis=2)
-        return x.transpose(0, 2, 1, 3)
 
     return from_bh(dq_p, h), from_bh_grouped(dk_p), from_bh_grouped(dv_p)
 

@@ -52,6 +52,37 @@ def hybrid_mesh_shapes(
     return (dp // dcn_dp, tp, sp, pp), (dcn_dp, 1, 1, 1)
 
 
+def pick_multislice_devices(devices: list, dcn_dp: int, per_slice: int) -> list:
+    """Select ``per_slice`` devices from EACH of ``dcn_dp`` TPU slices.
+
+    The multislice device-selection half of ``make_mesh(dcn_dp > 1)``,
+    factored pure (VERDICT.md r3 item 6: the positive branch was covered
+    only by refusal tests) so it runs in CI against mock devices carrying
+    ``slice_index``.  A flat ``devices[:need]`` prefix would grab slice
+    0's chips first and conclude "one slice"; this groups by
+    ``slice_index`` (None — non-multislice runtimes — never counts),
+    requires ``dcn_dp`` slices with at least ``per_slice`` devices each,
+    and returns slice-major, slice-contiguous devices — the order
+    ``create_hybrid_device_mesh`` expects so only the leading (DCN) mesh
+    factor crosses slices.
+    """
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", None), []).append(d)
+    usable = sorted(
+        s for s, g in groups.items() if s is not None and len(g) >= per_slice
+    )
+    if len(usable) < dcn_dp:
+        found = sorted(s for s in groups if s is not None)
+        raise ValueError(
+            f"dcn_dp={dcn_dp} needs {dcn_dp} TPU slices with >= "
+            f"{per_slice} devices each (found slice indices "
+            f"{found or 'none'}); multislice runs come from the TPU "
+            "runtime, not this host"
+        )
+    return [d for s in usable[:dcn_dp] for d in groups[s][:per_slice]]
+
+
 def make_mesh(
     dp: int | None = None,
     tp: int = 1,
@@ -94,24 +125,7 @@ def make_mesh(
         raise ValueError(f"mesh ({dp}x{tp}x{sp}x{pp}) needs {need} devices, have {n}")
     if dcn_dp > 1:
         ici_shape, dcn_shape = hybrid_mesh_shapes(dp, tp, sp, pp, dcn_dp)
-        # pick need/dcn_dp devices from EACH slice (flat devices[:need]
-        # would grab slice 0's chips first and see "one slice")
-        per_slice = need // dcn_dp
-        groups: dict = {}
-        for d in devices:
-            groups.setdefault(getattr(d, "slice_index", None), []).append(d)
-        usable = sorted(
-            s for s, g in groups.items() if s is not None and len(g) >= per_slice
-        )
-        if len(usable) < dcn_dp:
-            found = sorted(s for s in groups if s is not None)
-            raise ValueError(
-                f"dcn_dp={dcn_dp} needs {dcn_dp} TPU slices with >= "
-                f"{per_slice} devices each (found slice indices "
-                f"{found or 'none'}); multislice runs come from the TPU "
-                "runtime, not this host"
-            )
-        chosen = [d for s in usable[:dcn_dp] for d in groups[s][:per_slice]]
+        chosen = pick_multislice_devices(devices, dcn_dp, need // dcn_dp)
         from jax.experimental import mesh_utils
 
         arr = mesh_utils.create_hybrid_device_mesh(

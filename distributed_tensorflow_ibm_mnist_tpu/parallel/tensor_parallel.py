@@ -236,7 +236,7 @@ def make_tp_train_step(
     return jax.jit(
         train_step,
         in_shardings=(st_shard, {"image": img_shard, "label": lab_shard}),
-        out_shardings=(st_shard, {"loss": metric_shard, "accuracy": metric_shard}),
+        out_shardings=(st_shard, metric_shard),  # prefix: every metric replicated
         donate_argnums=(0,),
     )
 
@@ -288,6 +288,6 @@ def make_tp_epoch_runner(
     return jax.jit(
         run_epoch,
         in_shardings=(st_shard, img_shard, lab_shard, None),
-        out_shardings=(st_shard, {"loss": metric_shard, "accuracy": metric_shard}),
+        out_shardings=(st_shard, metric_shard),  # prefix: every metric replicated
         donate_argnums=(0,),
     )

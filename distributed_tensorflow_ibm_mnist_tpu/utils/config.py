@@ -66,8 +66,13 @@ class RunConfig:
     sp_impl: str = "ring"  # 'ring' (ppermute K/V rotation, scales past H
     #                        devices) | 'ulysses' (all_to_all head resharding;
     #                        composes with attn='flash' as the inner kernel)
-    causal: bool = False  # causal attention mask, plumbed through whichever
-    #                       attn path is active (sp island or single-device)
+    causal: bool | None = None  # causal attention mask, plumbed through
+    #   whichever attn path is active (sp island or single-device).
+    #   Tri-state: None (default) defers to the model FAMILY's declared
+    #   default (causal_lm ships causal=True); an explicit True/False wins
+    #   over the family default, so causal=False really trains a
+    #   bidirectional causal_lm.  model_kwargs={"causal": ...} outranks
+    #   both (it configures the model itself).
     pp: int = 1  # pipeline-parallel degree over the 'pipe' mesh axis (GPipe
     #              scan+ppermute over the ViT block stack; model must accept
     #              pipeline_fn/pp_stages and depth % pp == 0; composes with dp)

@@ -82,10 +82,12 @@ def attention_flops(
 ) -> float:
     """Analytic matmul FLOPs of multi-head attention, standard model-FLOPs
     convention: forward is the QK^T and PV matmuls (4*B*S^2*H*D), backward
-    counted at 2x forward, causal attention halved; a causal sliding
-    ``window`` caps each query at ``min(q+1, W)`` keys — summed exactly:
-    ``S*W - W*(W-1)/2`` scored pairs, continuous with the full-causal
-    count at W = S.
+    counted at 2x forward, causal attention halved (S^2/2 — the
+    scaling-literature convention, which halves the diagonal too); a
+    causal sliding ``window`` caps each query at ``min(q+1, W)`` keys,
+    counted in the SAME half-diagonal convention: ``S*W - W^2/2`` scored
+    pairs, exactly ``S^2/2`` at W = S — so MFU is continuous between
+    window=S and window=0 runs of the same shape (r3 advisor).
 
     This is the MFU-numerator convention of the scaling literature — the
     FLOPs the computation semantically NEEDS.  The flash kernels execute
@@ -97,7 +99,7 @@ def attention_flops(
     """
     if causal and window:
         w = min(window, seq)
-        pairs = seq * w - w * (w - 1) / 2.0  # sum over queries of min(q+1, W)
+        pairs = seq * w - w * w / 2.0  # sum of min(q+1, W), half-diagonal conv.
         f = 4.0 * batch * pairs * heads * head_dim * depth
     else:
         f = 4.0 * batch * seq * seq * heads * head_dim * depth

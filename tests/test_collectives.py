@@ -8,10 +8,14 @@ numpy ground truth — the auditable contract the parallelism strategies
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.parallel import collectives as cl
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh, shard_map_compat
+
+pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
+
 
 AXIS = "data"
 

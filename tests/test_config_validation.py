@@ -15,6 +15,9 @@ import pytest
 from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
 from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
 
+pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
+
+
 LM = dict(
     model="causal_lm",
     dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 64},
@@ -110,3 +113,19 @@ def test_sp_causal_lm_trains_causal_end_to_end(eight_devices):
     a, b = jax.device_get((t_implicit.state.params, t_explicit.state.params))
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=0.0)
+
+
+def test_causal_tristate_explicit_false_wins(eight_devices):
+    """config.causal is tri-state (r3 advisor): None defers to the family
+    default, but an EXPLICIT causal=False beats causal_lm's causal=True —
+    and lands in the model kwargs so the model's own attn_fn honors it."""
+    t = Trainer(_lm_cfg(dp=2, sp=4, sp_impl="ring", causal=False))
+    assert t.causal is False
+    # non-sp path: the flag must reach the model family's own causal knob
+    t2 = Trainer(_lm_cfg(dp=1, causal=False))
+    assert t2.causal is False
+    assert t2.model.causal is False
+    # and unset still derives the family default
+    t3 = Trainer(_lm_cfg(dp=1))
+    assert t3.causal is True
+    assert t3.model.causal is True

@@ -38,9 +38,9 @@ def test_ep_matches_local_forward(eight_devices):
     mesh = make_mesh(dp=8)
     params, x = _params(), _tokens()
     # ample capacity: local sees all T per expert, each shard sees T/8
-    out_ref, aux_ref = moe_ffn_local(params, x, E, capacity=T)
+    out_ref, aux_ref, _ = moe_ffn_local(params, x, E, capacity=T)
     ep = jax.jit(make_moe_dispatch(mesh, E, capacity=T // 8))
-    out_ep, aux_ep = ep(params, x)
+    out_ep, aux_ep, _ = ep(params, x)
     np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_ref), atol=1e-5)
     np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)
 
@@ -51,11 +51,11 @@ def test_ep_matches_local_grads(eight_devices):
     ep = make_moe_dispatch(mesh, E, capacity=T // 8)
 
     def loss_ep(p):
-        out, aux = ep(p, x)
+        out, aux, _ = ep(p, x)
         return jnp.sum(out**2) + 0.01 * aux
 
     def loss_ref(p):
-        out, aux = moe_ffn_local(p, x, E, capacity=T)
+        out, aux, _ = moe_ffn_local(p, x, E, capacity=T)
         return jnp.sum(out**2) + 0.01 * aux
 
     g_ep = jax.jit(jax.grad(loss_ep))(params)
@@ -69,8 +69,11 @@ def test_ep_matches_local_grads(eight_devices):
 def test_capacity_drops_tokens():
     """With capacity 1, an expert keeps only its first-arriving token."""
     params, x = _params(4), _tokens(5)
-    out_full, _ = moe_ffn_local(params, x, E, capacity=T)
-    out_tight, _ = moe_ffn_local(params, x, E, capacity=1)
+    out_full, _, stats_full = moe_ffn_local(params, x, E, capacity=T)
+    out_tight, _, stats_tight = moe_ffn_local(params, x, E, capacity=1)
+    # the drop is OBSERVABLE now (VERDICT.md r3 item 5), not just implied
+    assert float(stats_full["dropped"]) == 0.0
+    assert float(stats_tight["dropped"]) > 0.0
     # dropped tokens produce zero output rows; at least some must differ
     zero_rows = np.sum(np.all(np.asarray(out_tight) == 0.0, axis=-1))
     assert zero_rows > 0
@@ -174,7 +177,7 @@ def test_top2_routing_properties():
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
     w = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
-    dispatch, combine, _ = _route(x, w, n_experts=4, capacity=32, top_k=2)
+    dispatch, combine, _, _ = _route(x, w, n_experts=4, capacity=32, top_k=2)
     per_token = np.asarray(dispatch.sum(axis=(1, 2)))
     np.testing.assert_allclose(per_token, 2.0, atol=1e-6)  # 2 slots each
     gate_sums = np.asarray(combine.sum(axis=(1, 2)))
@@ -193,14 +196,16 @@ def test_top2_capacity_priority():
     # router forces every token's top-1 to expert 0, top-2 to expert 1
     x = jnp.ones((8, 2), jnp.float32)
     w = jnp.asarray([[3.0, 2.0, -9.0, -9.0], [3.0, 2.0, -9.0, -9.0]])
-    dispatch, _, _ = _route(x, w, n_experts=4, capacity=4, top_k=2)
+    dispatch, _, _, stats = _route(x, w, n_experts=4, capacity=4, top_k=2)
     d = np.asarray(dispatch)
     # expert 0 (everyone's first choice) fills to capacity with tokens 0-3
     assert d[:, 0].sum() == 4.0 and d[:4, 0].sum() == 4.0
     # expert 1 (everyone's second choice) also fills with tokens 0-3
     assert d[:, 1].sum() == 4.0 and d[:4, 1].sum() == 4.0
-    # tokens 4-7 dropped entirely
+    # tokens 4-7 dropped entirely — and the stat reports exactly that:
+    # 8 of 16 (token, choice) assignments found no slot
     assert d[4:].sum() == 0.0
+    np.testing.assert_allclose(float(stats["dropped"]), 0.5, atol=1e-6)
 
 
 def test_top2_ep_matches_local(eight_devices):
@@ -225,9 +230,9 @@ def test_top2_ep_matches_local(eight_devices):
     x = jnp.asarray(rng.normal(size=(t, d)).astype(np.float32))
     mesh = make_mesh(dp=8)
     # capacity ample on both paths: no drops -> identical math
-    out_l, aux_l = moe_ffn_local(params, x, e, capacity=t, top_k=2)
+    out_l, aux_l, _ = moe_ffn_local(params, x, e, capacity=t, top_k=2)
     ep = jax.jit(make_moe_dispatch(mesh, e, capacity=t // 8, top_k=2))
-    out_d, aux_d = ep(params, x)
+    out_d, aux_d, _ = ep(params, x)
     np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_l), atol=2e-5)
     np.testing.assert_allclose(float(aux_d), float(aux_l), atol=1e-5)
 
@@ -250,3 +255,69 @@ def test_config_driven_top2_moe_trains(eight_devices):
     t = Trainer(cfg)
     s = t.fit()
     assert np.isfinite(s["best_test_accuracy"])
+
+
+def test_z_loss_sown_and_weighted():
+    """z_weight > 0 sows the PRE-WEIGHTED router z-loss into 'zlosses'
+    (added to the training loss at weight 1.0 by core/steps.make_loss_fn);
+    z_weight = 0 sows nothing."""
+    x = jnp.asarray(np.random.default_rng(9).normal(size=(4, 8, D)).astype(np.float32))
+    block = MoEBlock(dim=D, n_experts=4, z_weight=1e-2)
+    # params only: init also runs the forward, so reusing its full output
+    # would carry init-time sown collections into the apply
+    params = {"params": block.init(jax.random.PRNGKey(0), x)["params"]}
+    _, st = block.apply(params, x, mutable=["losses", "zlosses", "moe_stats"])
+    z_w = float(st["zlosses"]["moe_z"][0])
+    assert z_w > 0.0
+    # raw z from the same routing, for the weighting check
+    tokens = x.reshape(-1, D)
+    cap = expert_capacity(tokens.shape[0], 4, 2.0)
+    _, _, stats = moe_ffn_local(params["params"], tokens, 4, cap)
+    np.testing.assert_allclose(z_w, 1e-2 * float(stats["z"]), rtol=1e-6)
+    assert float(st["moe_stats"]["dropped_frac"][0]) >= 0.0
+
+    block0 = MoEBlock(dim=D, n_experts=4)  # z off (default)
+    _, st0 = block0.apply(params, x, mutable=["losses", "zlosses", "moe_stats"])
+    assert "zlosses" not in st0 or not st0["zlosses"]
+
+
+def test_moe_dropped_frac_reaches_epoch_records(eight_devices):
+    """The capacity-overflow fraction flows routing -> step metrics ->
+    epoch records: an undersized capacity_factor reports a LARGE dropped
+    fraction, an ample one reports a small one (VERDICT.md r3 item 5)."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    def run(capacity_factor):
+        cfg = RunConfig(
+            name="moe_drop", model="vit",
+            model_kwargs={"patch_size": 7, "dim": 16, "depth": 2, "heads": 2,
+                          "moe_every": 1, "n_experts": 8,
+                          "moe_capacity_factor": capacity_factor,
+                          "dtype": jnp.float32},
+            dataset="mnist", synthetic=True, n_train=256, n_test=64,
+            batch_size=64, epochs=1, quiet=True, eval_batch_size=64, dp=8,
+        )
+        t = Trainer(cfg)
+        t.fit()
+        return t.history[-1]
+
+    starved = run(0.1)   # ~1/10 of balanced demand: most assignments drop
+    ample = run(8.0)     # capacity >= all tokens per expert: none drop
+    assert "moe_dropped_frac" in starved and "moe_dropped_frac" in ample
+    assert starved["moe_dropped_frac"] > 0.5, starved
+    assert ample["moe_dropped_frac"] < 1e-6, ample
+
+
+def test_non_moe_runs_have_no_drop_metric(eight_devices):
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="plain", model="mlp", model_kwargs={"hidden": (32,)},
+        dataset="mnist", synthetic=True, n_train=128, n_test=64,
+        batch_size=64, epochs=1, quiet=True, eval_batch_size=64,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    assert "moe_dropped_frac" not in t.history[-1]

@@ -5,12 +5,16 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
     compiled_flops,
     device_peak_tflops,
     mfu,
 )
+
+
+pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
 
 
 def test_compiled_flops_matmul():

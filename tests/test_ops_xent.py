@@ -9,6 +9,9 @@ import pytest
 from distributed_tensorflow_ibm_mnist_tpu.ops.xent import softmax_xent, softmax_xent_mean
 
 
+pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
+
+
 def _rand(n, c, seed=0, dtype=jnp.float32):
     k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
     logits = jax.random.normal(k1, (n, c), dtype) * 3.0

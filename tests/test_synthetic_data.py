@@ -11,6 +11,9 @@ from distributed_tensorflow_ibm_mnist_tpu.data import (
 )
 
 
+pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
+
+
 def test_mnist_shapes_and_dtypes():
     d = synthetic_mnist(n_train=512, n_test=128, seed=0)
     assert d["train_images"].shape == (512, 28, 28, 1)

@@ -178,7 +178,7 @@ params = {
     "w2": jnp.asarray(rng.normal(0, 0.3, (4, 64, 32)).astype(np.float32)),
     "b2": jnp.zeros((4, 32), jnp.float32),
 }
-out, aux = moe(params, jnp.asarray(rng.normal(0, 1, (16, 32)).astype(np.float32)))
+out, aux, _ = moe(params, jnp.asarray(rng.normal(0, 1, (16, 32)).astype(np.float32)))
 assert np.all(np.isfinite(jax.device_get(out))) and np.isfinite(float(aux))
 print("GSPMD_TPU_OK", loss, flush=True)
 '''
