@@ -226,35 +226,48 @@ def make_generator(
                 live &= ~jnp.all(finished)
             return live
 
-        def body(carry):
-            cache, tok, finished, toks, t = carry
-            # frozen rows feed pad (their logits are discarded anyway) and
-            # do NOT advance their cursor, so their cache stays put
+        # the per-row machinery is STATIC: uniform batches (prompt_lens
+        # None) keep the scalar-cursor decode fast path — ~40% of batched
+        # decode throughput (models/transformer.py ``ragged``).  Finished
+        # rows keep decoding in lockstep (their cursors advance with
+        # everyone's, bounded by the P+max_new<=max_len contract) and
+        # their sampled tokens are overwritten with pad — freezing their
+        # cursors would make the cursors per-row and force the slow path.
+        ragged = prompt_lens is not None
+
+        def step(cache, tok, finished, step_rng):
             step_logits, vars_ = model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
-                decode=True, max_len=max_len, mutable=["cache"],
+                decode=True, max_len=max_len, ragged=ragged,
+                mutable=["cache"],
             )
-            new_cache = vars_["cache"]
-            if eos_id is not None:
-                new_cache = jax.tree.map(
-                    lambda old, new: (
-                        jnp.where(finished, old, new)
-                        if old.ndim == 1 else new  # (B,) cursors only: the
-                        #   K/V write landed at a frozen row's cursor but a
-                        #   frozen cursor makes it invisible AND re-written
-                        #   next step — content above the cursor is dead
-                    ),
-                    cache, new_cache,
-                )
-            nxt = pick(step_logits[:, 0], rngs[t])
+            nxt = pick(step_logits[:, 0], step_rng)
             if eos_id is not None:
                 nxt = jnp.where(finished, pad_id, nxt)
                 finished = finished | (nxt == eos_id)
-            toks = toks.at[:, t].set(nxt)
-            return (new_cache, nxt, finished, toks, t + 1)
+            return vars_["cache"], nxt, finished
 
-        carry = (cache, first, finished, toks, jnp.asarray(1, jnp.int32))
-        _, _, _, toks, _ = jax.lax.while_loop(cond, body, carry)
+        if eos_id is None:
+            # static trip count -> lax.scan (XLA pipelines it measurably
+            # better than the equivalent while_loop: ~8% at B=32)
+            def sbody(carry, step_rng):
+                cache, tok = carry
+                cache, nxt, _ = step(cache, tok, finished, step_rng)
+                return (cache, nxt), nxt
+
+            (_, _), rest = jax.lax.scan(sbody, (cache, first), rngs[1:])
+            toks = jnp.concatenate([first[:, None], rest.T], axis=1)
+        else:
+            # EOS early exit needs a data-dependent loop: one decode step
+            # per iteration, done as soon as EVERY row has stopped
+            def body(carry):
+                cache, tok, finished, toks, t = carry
+                cache, nxt, finished = step(cache, tok, finished, rngs[t])
+                toks = toks.at[:, t].set(nxt)
+                return (cache, nxt, finished, toks, t + 1)
+
+            carry = (cache, first, finished, toks, jnp.asarray(1, jnp.int32))
+            _, _, _, toks, _ = jax.lax.while_loop(cond, body, carry)
 
         # assemble (B, P+max_new): each row's real prompt, its generated
         # tokens at ITS length, pad everywhere else

@@ -78,7 +78,7 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
-                 max_len: int = 0):
+                 max_len: int = 0, ragged: bool = False):
         b, s = tokens.shape
         if self.window < 0:
             raise ValueError(f"window must be >= 0, got {self.window}")
@@ -150,7 +150,10 @@ class CausalLM(nn.Module):
         # decode/max_len ride as kwargs only when decoding so the training
         # trace (incl. the remat-wrapped class, whose static_argnums cover
         # positional train only) is byte-identical to previous rounds
-        extra = {"decode": True, "max_len": max_len} if decode else {}
+        extra = (
+            {"decode": True, "max_len": max_len, "ragged": ragged}
+            if decode else {}
+        )
         for i in range(self.depth):
             x = block_cls(
                 dim=self.dim, heads=self.heads, heads_kv=self.heads_kv,

@@ -117,7 +117,7 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
-                 max_len: int = 0):
+                 max_len: int = 0, ragged: bool = False):
         b, s, _ = x.shape
         head_dim = self.dim // self.heads
 
@@ -141,7 +141,7 @@ class TransformerBlock(nn.Module):
             kv = kv.reshape(b, s, 2, hkv, head_dim)
             k, v = kv[:, :, 0], kv[:, :, 1]
         if decode:
-            o = self._decode_attention(q, k, v, max_len)
+            o = self._decode_attention(q, k, v, max_len, ragged)
         else:
             if self.rope:
                 q, k = apply_rope(q), apply_rope(k)
@@ -175,7 +175,7 @@ class TransformerBlock(nn.Module):
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
 
-    def _decode_attention(self, q, k, v, max_len: int):
+    def _decode_attention(self, q, k, v, max_len: int, ragged: bool = False):
         """Incremental (KV-cache) attention for autoregressive decoding.
 
         Appends this call's K/V at the running per-row ``cache_index`` (a
@@ -189,6 +189,15 @@ class TransformerBlock(nn.Module):
         ``dynamic_update_slice``), RoPE rotates at per-row absolute
         offsets, and the causal mask ``k_pos <= cursor`` keeps every row
         from seeing the pad garbage beyond its own prefix.
+
+        ``ragged`` is STATIC: the per-row machinery (scatter-shaped cache
+        writes, (B, S, half) rotation angles, (B, S, max_len) mask) costs
+        ~40% of batched decode throughput when the rows are actually
+        uniform, so the uniform case — ``prompt_lens=None``, including
+        EOS-stopped batches, whose cursors advance in lockstep — keeps the
+        scalar-cursor path (one ``dynamic_update_slice``, shared angles,
+        (S, max_len) mask).  The cursor variable stays (B,)-shaped in both
+        modes so the cache pytree is layout-compatible.
 
         Dtype policy matches the flash kernel (ops/flash_attention.py):
         native-dtype MXU operands with f32 accumulation
@@ -217,23 +226,34 @@ class TransformerBlock(nn.Module):
         idx_var = self.variable(
             "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
         idx = idx_var.value  # (B,) per-row decode cursor
-        if self.rope:
-            q = apply_rope(q, offset=idx)
-            k = apply_rope(k, offset=idx)
         import jax
 
-        row_update = jax.vmap(
-            lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0)))
-        cache_k.value = row_update(
-            cache_k.value, k.astype(cache_k.value.dtype), idx)
-        cache_v.value = row_update(
-            cache_v.value, v.astype(cache_v.value.dtype), idx)
+        if ragged:
+            if self.rope:
+                q = apply_rope(q, offset=idx)
+                k = apply_rope(k, offset=idx)
+            row_update = jax.vmap(
+                lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0)))
+            cache_k.value = row_update(
+                cache_k.value, k.astype(cache_k.value.dtype), idx)
+            cache_v.value = row_update(
+                cache_v.value, v.astype(cache_v.value.dtype), idx)
+            q_pos = idx[:, None] + jnp.arange(s)  # (B, S) absolute positions
+        else:
+            idx0 = idx[0]  # uniform rows: ONE cursor, one slice update
+            if self.rope:
+                q = apply_rope(q, offset=idx0)
+                k = apply_rope(k, offset=idx0)
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(cache_k.value.dtype), (0, idx0, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(cache_v.value.dtype), (0, idx0, 0, 0))
+            q_pos = (idx0 + jnp.arange(s))[None]  # (1, S) broadcasts over B
         idx_var.value = idx + s
 
         kc, vc = cache_k.value, cache_v.value
         k_pos = jnp.arange(max_len)
-        q_pos = idx[:, None] + jnp.arange(s)  # (B, S) absolute positions
-        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, S, max_len)
+        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B|1, S, max_len)
         if self.window:
             mask &= k_pos[None, None, :] > q_pos[:, :, None] - self.window
         scale = d ** -0.5

@@ -48,6 +48,7 @@ def make_pipeline_apply(
     axis_name: str = AXIS,
     remat: bool = False,
     batch_axis: str | None = None,
+    param_specs=None,
 ):
     """Build ``apply(stage_params, x) -> y`` streaming x through the stages.
 
@@ -59,11 +60,19 @@ def make_pipeline_apply(
     * ``batch_axis`` — mesh axis the batch dim stays sharded over (DP x PP
       composition: each data shard streams its local batch through its own
       pipe ring; ``None`` replicates the batch as before).
+    * ``param_specs`` — optional per-leaf PartitionSpec tree for the stage
+      params (default: ``P(axis_name)`` prefix, stage dim only).  The
+      pp x tp composition passes :func:`tp_stage_specs` here so attention/
+      MLP weights are ALSO sharded over ``model`` inside the island, with
+      ``stage_fn`` doing the matching explicit-collective math
+      (:func:`make_tp_block_stage_fn`).
 
     Returns the full-batch output, replicated over the ``pipe`` axis.
     """
     n_stages = mesh.shape[axis_name]
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    if param_specs is None:
+        param_specs = P(axis_name)
 
     def pipelined(stage_params, x):
         # shard_map body: stage_params leaves are (1, ...) — this shard's stage.
@@ -99,8 +108,153 @@ def make_pipeline_apply(
         return jnp.reshape(outputs, (x.shape[0],) + outputs.shape[2:])
 
     return shard_map_compat(
-        pipelined, mesh, in_specs=(P(axis_name), P(batch_axis)), out_specs=P(batch_axis)
+        pipelined, mesh, in_specs=(param_specs, P(batch_axis)), out_specs=P(batch_axis)
     )
+
+
+def permute_qkv_head_major(stacked, heads: int, head_dim: int):
+    """Reorder the fused qkv projection's output features head-major.
+
+    flax's fused ``qkv`` Dense lays its 3*dim output features out
+    (q|k|v)-major — ``flat = (c*heads + h)*head_dim + d`` — so a contiguous
+    tp-way column split hands shard 0 "all of q plus some of k", which no
+    explicit per-head attention can use.  This relayout (outside the
+    island, on the stacked global arrays) reorders to head-major —
+    ``flat = (h*3 + c)*head_dim + d`` — after which a contiguous split over
+    ``model`` gives each shard COMPLETE (q, k, v) triples for its
+    ``heads/tp`` heads.  Only qkv kernel/bias change; every other leaf
+    splits cleanly as stored.
+
+    Cost note: params are the epoch scan's CARRY (updated every step), so
+    this transpose (and its backward) runs per step — XLA cannot hoist a
+    computation over a scan-carried operand.  It is one weight-sized
+    reshuffle per step, negligible next to the stage matmuls; storing the
+    weights head-major would remove it but change the checkpoint layout
+    and the flax-stack fallback path, a trade not worth taking at zoo
+    scale.
+    """
+    def fix(path, leaf):
+        if "qkv" not in path:
+            return leaf
+        lead = leaf.shape[:-1]
+        x = leaf.reshape(*lead, 3, heads, head_dim)
+        x = jnp.swapaxes(x, -3, -2)  # (..., heads, 3, head_dim)
+        return x.reshape(*lead, 3 * heads * head_dim)
+
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, v: fix(tuple(getattr(k, "key", k) for k in kp), v), stacked
+    )
+
+
+def tp_stage_specs(stacked, tp_axis: str = "model", axis: str = AXIS):
+    """Per-leaf island PartitionSpecs for a stacked TransformerBlock tree
+    under pp x tp: stage dim over ``pipe`` everywhere, plus the Megatron
+    dims over ``model`` — qkv/dense_0 column-parallel (last dim), proj/
+    dense_1 row-parallel (second-to-last), LayerNorms replicated.
+    Leaves are ``(n_stages, per_stage, ...)``."""
+    col = {"qkv", "dense_0"}
+    row = {"proj", "dense_1"}
+
+    def spec(path, leaf):
+        mods = set(path)
+        n = leaf.ndim
+        if mods & col:
+            return P(axis, *([None] * (n - 2)), tp_axis)
+        if mods & row:
+            if path[-1] == "kernel":
+                return P(axis, *([None] * (n - 3)), tp_axis, None)
+            return P(axis, *([None] * (n - 1)))  # row-parallel bias: replicated
+        return P(axis, *([None] * (n - 1)))
+
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, v: spec(tuple(getattr(k, "key", k) for k in kp), v), stacked
+    )
+
+
+def make_tp_block_stage_fn(
+    heads: int,
+    head_dim: int,
+    tp: int,
+    attn_fn: Callable,
+    rope: bool = False,
+    dtype=jnp.bfloat16,
+    tp_axis: str = "model",
+    eps: float = 1e-6,
+    block_remat: bool = False,
+):
+    """Explicit-collective Megatron TransformerBlock stack for pp x tp.
+
+    The GPipe island is a ``shard_map`` body, so GSPMD cannot propagate
+    shardings into it — tensor parallelism inside stages must be written
+    with explicit collectives (the round-2/3 "measured rejection", now
+    implemented).  Each ``model`` shard holds ``heads/tp`` heads' worth of
+    the (head-major-permuted — :func:`permute_qkv_head_major`) qkv columns
+    and ``mlp_hidden/tp`` of dense_0's columns; the two row-parallel
+    matmuls (proj, dense_1) produce partial sums finished by ONE
+    ``lax.psum`` over ``model`` each — the standard Megatron count of one
+    reduction per sublayer pair.  Math mirrors
+    models/transformer.TransformerBlock (pre-norm, fast-variance
+    LayerNorm, approximate gelu, compute in ``dtype``) so the island is
+    numerically the flax stack; the shape-fallback path
+    (core/trainer._make_pipeline_fn) runs the flax stack itself on the
+    SAME stored params, which pins the equivalence in tests.
+
+    Returns ``stage_fn(local_stage_params, h)`` for
+    :func:`make_pipeline_apply` with ``param_specs=tp_stage_specs(...)``;
+    ``local_stage_params`` leaves are ``(1, per_stage, ...)`` slices.
+    MHA only (the GQA q/kv split has its own projection layout; the
+    trainer refuses that composition).
+    """
+    if heads % tp:
+        raise ValueError(f"heads ({heads}) must divide by tp ({tp})")
+    hl = heads // tp  # local heads per model shard
+
+    def _ln(x, p):
+        x = x.astype(dtype)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.maximum(
+            jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        return y * p["scale"].astype(dtype) + p["bias"].astype(dtype)
+
+    def _dense(x, p):
+        return x.astype(dtype) @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
+
+    def block(p, x):
+        b, s, dim = x.shape
+        h = _ln(x, p["norm_attn"])
+        qkv = _dense(h, p["qkv"])  # (B, S, hl*3*head_dim), head-major layout
+        qkv = qkv.reshape(b, s, hl, 3, head_dim)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if rope:
+            from distributed_tensorflow_ibm_mnist_tpu.models.transformer import apply_rope
+
+            q, k = apply_rope(q), apply_rope(k)
+        o = attn_fn(q, k, v).reshape(b, s, hl * head_dim)
+        # row-parallel proj: local heads x local kernel rows -> partial sum
+        o = o.astype(dtype) @ p["proj"]["kernel"].astype(dtype)
+        o = jax.lax.psum(o, tp_axis) + p["proj"]["bias"].astype(dtype)
+        x = x + o
+
+        h = _ln(x, p["norm_mlp"])
+        hh = jax.nn.gelu(_dense(h, p["dense_0"]))  # column-parallel
+        y = hh.astype(dtype) @ p["dense_1"]["kernel"].astype(dtype)
+        y = jax.lax.psum(y, tp_axis) + p["dense_1"]["bias"].astype(dtype)
+        return x + y
+
+    if block_remat:
+        block = jax.checkpoint(block)
+
+    def stage_fn(stage_params, h):
+        # the island body already dropped the pipe dim: leaves arrive
+        # (per_stage, ...) — scan this stage's blocks in order
+        def body(c, p):
+            return block(p, c), None
+
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
+
+    return stage_fn
 
 
 def pipeline_block_rule(axis: str = AXIS, marker: str = "pipe_blocks"):

@@ -56,6 +56,18 @@ def main():
                                rng=jax.random.PRNGKey(0))
     print("sampled row:       ", np.asarray(sampled[0, 8:24]))
 
+    # Production decode semantics (round 4): a ragged right-padded batch
+    # — each row decodes from ITS OWN length — with an EOS stop token
+    # (per-row freeze, early loop exit) and pad filling afterwards.
+    # Repeat calls with the same shapes hit the Trainer's generator cache
+    # and the device-resident params: no re-jit, no host round-trip.
+    ragged = jnp.zeros((2, 8), jnp.int32)
+    ragged = ragged.at[0, :8].set(prompt[0]).at[1, :3].set(prompt[1, :3])
+    out = trainer.generate(ragged, max_new=16, eos_id=2, pad_id=0,
+                           prompt_lens=jnp.asarray([8, 3], jnp.int32))
+    print("ragged row 0 (len 8):", np.asarray(out[0]))
+    print("ragged row 1 (len 3):", np.asarray(out[1]))
+
 
 if __name__ == "__main__":
     main()

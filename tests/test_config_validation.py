@@ -15,8 +15,6 @@ import pytest
 from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
 from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
 
-pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
-
 
 LM = dict(
     model="causal_lm",

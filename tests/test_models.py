@@ -8,8 +8,6 @@ import pytest
 from distributed_tensorflow_ibm_mnist_tpu.models import available_models, get_model
 
 
-pytestmark = pytest.mark.quick  # core numerics: part of the -m quick signal loop
-
 
 @pytest.mark.parametrize(
     "name,kwargs,in_shape",
