@@ -931,7 +931,7 @@ class Trainer:
     def generate(self, prompt, max_new: int, max_len: int | None = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, eos_id: int | None = None, pad_id: int = 0,
-                 prompt_lens=None):
+                 prompt_lens=None, on_mesh: bool = False):
         """Autoregressive decode from this run's trained weights
         (core/generate.py; causal-LM family only).
 
@@ -943,6 +943,17 @@ class Trainer:
         compiled cache size across varying prompt lengths.  ``eos_id`` /
         ``pad_id`` / ``prompt_lens`` per :func:`~..core.generate.
         make_generator` (stop tokens, ragged right-padded prompts).
+
+        ``on_mesh=True`` decodes IN the run's own sharded layout instead
+        of re-laying out to one device: the generator jit receives the
+        tp/fsdp-sharded params as-is and GSPMD partitions the decode —
+        qkv/head matmuls split over ``model`` (the KV cache follows the
+        activations' head sharding), fsdp layers gathered per use.  This
+        is the multi-chip serving form: nothing is re-laid out, nothing
+        crosses the host, and a pod-sized model that cannot fit one chip
+        decodes where it trained.  Requires a GSPMD run (tp/fsdp);
+        sp-island runs decode via the default single-device path (the
+        decode model drops the training islands).
         """
         if not model_accepts(self.config.model, "pos"):
             raise ValueError(
@@ -964,6 +975,23 @@ class Trainer:
                 "run trained a BIDIRECTIONAL model (causal=False), whose "
                 "logits condition on future positions the decode path cannot "
                 "provide — train causally to decode"
+            )
+        if on_mesh and not (self.tp > 1 or self.config.fsdp):
+            # tp/fsdp only — NOT the rest of _gspmd: sp/EP runs shard via
+            # islands the decode model drops (their param layouts have no
+            # meaning to the clean decode program), and dp-replicated runs
+            # gain nothing over the default path
+            raise ValueError(
+                "on_mesh=True decodes in the run's GSPMD layout; this run "
+                "has none (tp/fsdp shard params — dp/sp/EP and single-chip "
+                "runs decode via the default path)"
+            )
+        if on_mesh and self.sp > 1:
+            raise ValueError(
+                "on_mesh=True with sp>1 is unsupported: the decode model "
+                "drops the sequence-parallel islands, so its params/cache "
+                "have no 'seq' layout to decode in — use the default "
+                "single-device path"
             )
         prompt = jnp.asarray(prompt)
         if prompt.ndim == 1:
@@ -989,8 +1017,8 @@ class Trainer:
             gen = make_generator(model, max_len, max_new, temperature,
                                  top_k, top_p, eos_id=eos_id, pad_id=pad_id)
             cache[key] = gen
-        return gen(self._decode_params(), prompt, rng=rng,
-                   prompt_lens=prompt_lens)
+        params = self.state.params if on_mesh else self._decode_params()
+        return gen(params, prompt, rng=rng, prompt_lens=prompt_lens)
 
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))

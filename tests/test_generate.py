@@ -17,6 +17,17 @@ from distributed_tensorflow_ibm_mnist_tpu.models import get_model
 KW = dict(num_classes=16, dim=64, depth=2, heads=4, dtype=jnp.float32)
 
 
+class _NoDeviceGet:
+    """jax proxy forbidding host gathers — shared guard for the
+    device-residency tests below."""
+
+    def __getattr__(self, name):
+        if name == "device_get":
+            raise AssertionError("host gather in generate path")
+        return getattr(jax, name)
+
+
+
 def _model_and_params(seed=0, **over):
     model = get_model("causal_lm", **{**KW, **over})
     params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))[
@@ -343,12 +354,6 @@ def test_trainer_generate_no_host_transfer_and_no_recompile():
     t.fit()
     prompt = jnp.asarray([[2, 9, 4, 7]], jnp.int32)
 
-    class _NoDeviceGet:
-        def __getattr__(self, name):
-            if name == "device_get":
-                raise AssertionError("host gather in generate path")
-            return getattr(jax, name)
-
     real_jax = trainer_mod.jax
     trainer_mod.jax = _NoDeviceGet()
     try:
@@ -388,12 +393,6 @@ def test_trainer_generate_sharded_params_gather_on_device(eight_devices):
     t.fit()
     real_jax = trainer_mod.jax
 
-    class _NoDeviceGet:
-        def __getattr__(self, name):
-            if name == "device_get":
-                raise AssertionError("host gather in generate path")
-            return getattr(jax, name)
-
     trainer_mod.jax = _NoDeviceGet()
     try:
         out = t.generate(jnp.asarray([[2, 9, 4, 7]], jnp.int32), max_new=4)
@@ -432,3 +431,53 @@ def test_prompt_lens_validated_and_bidirectional_refused():
     t = Trainer(cfg)
     with pytest.raises(ValueError, match="BIDIRECTIONAL"):
         t.generate(prompt, max_new=2)
+
+
+def test_generate_on_mesh_matches_single_device(eight_devices):
+    """on_mesh=True decodes IN the tp-sharded layout (GSPMD partitions the
+    decode; nothing re-laid out, nothing through the host) and must equal
+    the single-device decode bit for bit."""
+    from distributed_tensorflow_ibm_mnist_tpu.core import trainer as trainer_mod
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="genmesh", model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 1, "heads": 4, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32, tp=4,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    prompt = jnp.asarray([[2, 9, 4, 7], [1, 3, 3, 7]], jnp.int32)
+    single = t.generate(prompt, max_new=8)
+
+    # prove on_mesh really bypasses the single-device re-layout: clear the
+    # decode-params cache — the on_mesh call must leave it EMPTY (a silent
+    # fallback to _decode_params would repopulate it) and touch no host
+    t._gen_params = None
+    real_jax = trainer_mod.jax
+    trainer_mod.jax = _NoDeviceGet()
+    try:
+        meshed = t.generate(prompt, max_new=8, on_mesh=True)
+    finally:
+        trainer_mod.jax = real_jax
+    assert t._gen_params is None  # no single-device re-layout happened
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
+    # the params fed in stayed in the run's multi-device layout
+    leaf = jax.tree.leaves(t.state.params)[0]
+    assert len(leaf.sharding.device_set) == 4
+
+    # refusals fire from config-derived state — no training needed:
+    # dp-replicated (no GSPMD layout) and EP-only (island-sharded params
+    # the clean decode model cannot interpret) are both routed away
+    with pytest.raises(ValueError, match="on_mesh"):
+        Trainer(cfg.replace(name="genmesh_dp", tp=1, dp=2)).generate(
+            prompt, max_new=2, on_mesh=True)
+    cfg_ep = cfg.replace(
+        name="genmesh_ep", tp=1, dp=2,
+        model_kwargs={**cfg.model_kwargs, "moe_every": 1, "n_experts": 2},
+    )
+    with pytest.raises(ValueError, match="on_mesh"):
+        Trainer(cfg_ep).generate(prompt, max_new=2, on_mesh=True)
