@@ -227,12 +227,13 @@ def make_generator(
             return live
 
         # the per-row machinery is STATIC: uniform batches (prompt_lens
-        # None) keep the scalar-cursor decode fast path — ~40% of batched
-        # decode throughput (models/transformer.py ``ragged``).  Finished
-        # rows keep decoding in lockstep (their cursors advance with
-        # everyone's, bounded by the P+max_new<=max_len contract) and
-        # their sampled tokens are overwritten with pad — freezing their
-        # cursors would make the cursors per-row and force the slow path.
+        # None) keep the scalar-cursor decode fast path — measured ~18%
+        # of batched decode throughput at B=8 (models/transformer.py
+        # ``ragged``, docs/PERFORMANCE.md).  Finished rows keep decoding
+        # in lockstep (their cursors advance with everyone's, bounded by
+        # the P+max_new<=max_len contract) and their sampled tokens are
+        # overwritten with pad — freezing their cursors would make the
+        # cursors per-row and force the slow path.
         ragged = prompt_lens is not None
 
         def step(cache, tok, finished, step_rng):

@@ -993,6 +993,14 @@ class Trainer:
                 "have no 'seq' layout to decode in — use the default "
                 "single-device path"
             )
+        if on_mesh and self._moe_ep:
+            raise ValueError(
+                "on_mesh=True with expert parallelism is unsupported: the "
+                "expert weights live in the EP island's 'data'-sharded "
+                "layout, which the clean decode program (MoE decode is "
+                "refused by the model anyway) cannot interpret — use the "
+                "default single-device path"
+            )
         prompt = jnp.asarray(prompt)
         if prompt.ndim == 1:
             prompt = prompt[None, :]

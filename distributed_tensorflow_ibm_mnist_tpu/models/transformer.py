@@ -191,13 +191,14 @@ class TransformerBlock(nn.Module):
         from seeing the pad garbage beyond its own prefix.
 
         ``ragged`` is STATIC: the per-row machinery (scatter-shaped cache
-        writes, (B, S, half) rotation angles, (B, S, max_len) mask) costs
-        ~40% of batched decode throughput when the rows are actually
-        uniform, so the uniform case — ``prompt_lens=None``, including
-        EOS-stopped batches, whose cursors advance in lockstep — keeps the
-        scalar-cursor path (one ``dynamic_update_slice``, shared angles,
-        (S, max_len) mask).  The cursor variable stays (B,)-shaped in both
-        modes so the cache pytree is layout-compatible.
+        writes, (B, S, half) rotation angles, (B, S, max_len) mask)
+        measures ~18% of batched decode throughput at B=8 (docs/
+        PERFORMANCE.md), so the uniform case — ``prompt_lens=None``,
+        including EOS-stopped batches, whose cursors advance in lockstep
+        — keeps the scalar-cursor path (one ``dynamic_update_slice``,
+        shared angles, (S, max_len) mask).  The cursor variable stays
+        (B,)-shaped in both modes so the cache pytree is
+        layout-compatible.
 
         Dtype policy matches the flash kernel (ops/flash_attention.py):
         native-dtype MXU operands with f32 accumulation

@@ -82,12 +82,14 @@ _FWD_BLOCK_K = 1024
 
 # Fused-backward gate: the one-walk backward keeps dQ's whole (padded) row
 # in VMEM — an f32 accumulator plus the output block in the input dtype,
-# S_pad * D * (4 + itemsize) bytes.  6 MB leaves ~10 MB of the 16 MB
+# S_pad * D * (4 + itemsize) bytes.  4 MB leaves ~12 MB of the 16 MB
 # scoped-VMEM budget for the double-buffered tile operands and the f32
-# score/p/ds intermediates at the default 512x1024 tiles (S=8192, D=64
-# bf16 needs 3 MB and fits; rows past ~1M elements fall back to the
-# two-kernel scheme).
-_FUSED_DQ_VMEM_BUDGET = 6 * 1024 * 1024
+# score/p/ds intermediates at the default 512x1024 tiles: S=8192 D=64
+# bf16 needs 3 MB and compiles at ~11 MB scoped; S=16384 needs 6.3 MB
+# and was MEASURED to blow the scoped limit (20.5 MB requested — the
+# row buffer plus the intermediates don't co-fit), so rows past the
+# 4 MB line take the two-kernel fallback.
+_FUSED_DQ_VMEM_BUDGET = 4 * 1024 * 1024
 
 
 def _on_tpu() -> bool:

@@ -481,3 +481,36 @@ def test_generate_on_mesh_matches_single_device(eight_devices):
     )
     with pytest.raises(ValueError, match="on_mesh"):
         Trainer(cfg_ep).generate(prompt, max_new=2, on_mesh=True)
+
+
+def test_bf16_model_decodes():
+    """The zoo's default compute dtype (bf16) decodes: greedy generate is
+    deterministic, in-vocab, and the cache pytree carries bf16 K/V."""
+    model, params = _model_and_params(seed=13, dtype=jnp.bfloat16)
+    gen = make_generator(model, max_len=24, max_new=8)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    a, b = gen(params, prompt), gen(params, prompt)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (1, 12) and 0 <= int(jnp.min(a)) and int(jnp.max(a)) < 16
+    _, vars_ = model.clone(sow_kv=True).apply(
+        {"params": params}, prompt, decode=True, max_len=24, mutable=["cache"])
+    assert vars_["cache"]["block_0"]["k"].dtype == jnp.bfloat16
+
+
+def test_on_mesh_refuses_ep_with_tp(eight_devices):
+    """tp>1 alone must not admit an EP run to on_mesh decode: the expert
+    weights live in the island's 'data'-sharded layout (code-review r4)."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="genmesh_ep_tp", model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 2, "heads": 4, "moe_every": 2,
+                      "n_experts": 2, "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32, tp=2, dp=2,
+    )
+    with pytest.raises(ValueError, match="expert"):
+        Trainer(cfg).generate(jnp.zeros((1, 4), jnp.int32), max_new=2,
+                              on_mesh=True)
