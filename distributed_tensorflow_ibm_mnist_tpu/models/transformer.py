@@ -261,8 +261,10 @@ class TransformerBlock(nn.Module):
             # W+s-1 span starting at max(idx0-W+1, 0).  The span's end
             # never exceeds idx0+s <= max_len (the cache contract), so
             # the dynamic_slice start is exact, and masking the gathered
-            # span with its true positions reproduces the full-cache
-            # softmax bit for bit.  Ragged rows keep the full-cache form
+            # span with its true positions keeps the full-cache softmax's
+            # exact support (numerically equivalent; reduction trees over
+            # span vs max_len elements round ~1e-7 apart, so not
+            # bit-identical).  Ragged rows keep the full-cache form
             # (per-row spans would need per-row gathers).
             span = self.window + s - 1
             start = jnp.maximum(idx0 - self.window + 1, 0)
