@@ -17,6 +17,10 @@ Production decode semantics (VERDICT.md r3 item 3):
   per-row cursor), new K/V land at per-row positions, and RoPE rotates at
   per-row absolute offsets — so a batched decode of mixed-length prompts
   is position-for-position identical to decoding each prompt alone.
+  (One carve-out: MoE models route each forward's tokens jointly, so
+  under capacity PRESSURE a batch's drop pattern can differ from a
+  solo run's — with capacity ample enough to drop nothing, the identity
+  holds for MoE too.)
   Right-padding works because causal attention never looks forward: real
   tokens can't see the pads, and the pad K/V beyond a row's cursor are
   masked by the causal prefix mask until generation overwrites them.

@@ -919,14 +919,40 @@ class Trainer:
         cached = getattr(self, "_gen_params", None)
         if cached is not None and cached[0] is src:
             return cached[1]
+        tree = self._decode_param_tree()
         dev = (
             next(iter(self.mesh.devices.flat)) if self.mesh is not None
             else jax.devices()[0]
         )
         sharding = jax.sharding.SingleDeviceSharding(dev)
-        placed = jax.device_put(src, jax.tree.map(lambda _: sharding, src))
+        placed = jax.device_put(tree, jax.tree.map(lambda _: sharding, tree))
         self._gen_params = (src, placed)
         return placed
+
+    def _decode_param_tree(self):
+        """The run's params in the DECODE model's layout.
+
+        Pipeline-trained runs store the block stack as one
+        ``pipe_blocks/stacked`` tree with leading ``(n_stages, per_stage)``
+        dims; the decode model runs the plain ``block_{i}`` stack, so the
+        stacked leaves are sliced back out in schedule order
+        (``block_{s*per_stage + p}`` — exactly the order the GPipe scan
+        visits them, so decode logits match the trained forward).  A
+        device-side slice per block; everything else passes through by
+        name.
+        """
+        src = self.state.params
+        if "pipe_blocks" not in src:
+            return src
+        stacked = src["pipe_blocks"]["stacked"]
+        lead = jax.tree.leaves(stacked)[0].shape
+        n_stages, per_stage = int(lead[0]), int(lead[1])
+        out = {k: v for k, v in src.items() if k != "pipe_blocks"}
+        for s_i in range(n_stages):
+            for p_i in range(per_stage):
+                out[f"block_{s_i * per_stage + p_i}"] = jax.tree.map(
+                    lambda a: a[s_i, p_i], stacked)
+        return out
 
     def generate(self, prompt, max_new: int, max_len: int | None = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
@@ -962,13 +988,11 @@ class Trainer:
             )
         from distributed_tensorflow_ibm_mnist_tpu.core.generate import make_generator
 
-        if self.pp > 1 or self.config.model_kwargs.get("pp_stages", 0):
-            raise ValueError(
-                "generate() from a stage-stacked run is unsupported: params "
-                "live under pipe_blocks/stacked and the decode path runs the "
-                "plain block stack — train with pp=1 and no pp_stages to "
-                "decode"
-            )
+        # pp-trained runs decode too (round 4): _decode_param_tree slices
+        # the pipe_blocks/stacked tree back into the plain block_{i}
+        # layout the decode model runs — but not in the pipe-sharded
+        # layout itself (the stacked params have no meaning to the clean
+        # decode program), so on_mesh is refused below.
         if not self.causal:
             raise ValueError(
                 "generate() is autoregressive (KV-cache causal decode); this "
@@ -997,9 +1021,16 @@ class Trainer:
             raise ValueError(
                 "on_mesh=True with expert parallelism is unsupported: the "
                 "expert weights live in the EP island's 'data'-sharded "
-                "layout, which the clean decode program (MoE decode is "
-                "refused by the model anyway) cannot interpret — use the "
-                "default single-device path"
+                "layout, which the clean decode program (local MoE blocks) "
+                "cannot interpret — the default path gathers them to one "
+                "device and decodes with local routing"
+            )
+        if on_mesh and (self.pp > 1 or self.config.model_kwargs.get("pp_stages", 0)):
+            raise ValueError(
+                "on_mesh=True with pipeline stages is unsupported: the "
+                "decode model runs the plain block stack, not the "
+                "pipe-sharded pipe_blocks/stacked layout — use the default "
+                "path (which unstacks the stages on device)"
             )
         prompt = jnp.asarray(prompt)
         if prompt.ndim == 1:

@@ -88,9 +88,12 @@ class CausalLM(nn.Module):
                 "the trained length into a (1, S, dim) table that cannot "
                 "address incremental positions — use pos='rope' (the default)"
             )
-        if decode and (self.pp_stages > 0 or self.moe_every > 0):
-            raise ValueError("decode mode supports the plain block stack "
-                             "(no pp_stages, no MoE)")
+        if decode and self.pp_stages > 0:
+            raise ValueError(
+                "decode mode runs the plain block stack, not stage-stacked "
+                "params — Trainer.generate unstacks pp-trained weights into "
+                "this layout for you (core/trainer._decode_param_tree)"
+            )
         embed = nn.Embed(self.num_classes, self.dim, dtype=self.dtype,
                          name="embed")
         x = embed(tokens.astype(jnp.int32))

@@ -157,8 +157,12 @@ class TransformerBlock(nn.Module):
         x = x + o
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_mlp")(x)
-        if decode and self.use_moe:
-            raise ValueError("decode mode does not support MoE blocks")
+        # MoE blocks decode too (round 4): routing is per-call — the decode
+        # step routes its B current tokens with capacity sized for B, the
+        # standard MoE serving semantics (equal to full-forward logits
+        # whenever capacity drops nothing; under pressure the per-step
+        # routing drops differently than a full-sequence pass would).
+        # Aux-loss/stat sows are no-ops outside mutable collections.
         if self.use_moe:
             from distributed_tensorflow_ibm_mnist_tpu.parallel.expert_parallel import MoEBlock
 
