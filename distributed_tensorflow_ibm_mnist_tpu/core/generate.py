@@ -20,7 +20,13 @@ Production decode semantics (VERDICT.md r3 item 3):
   (One carve-out: MoE models route each forward's tokens jointly, so
   under capacity PRESSURE a batch's drop pattern can differ from a
   solo run's — with capacity ample enough to drop nothing, the identity
-  holds for MoE too.)
+  holds for MoE too.  Ragged MoE prefill sharpens this: right-pad
+  positions go through the router alongside real tokens, so pad
+  garbage can CLAIM expert capacity and displace real tokens' slots —
+  pads compete, not just other rows' real tokens.  Size
+  ``moe_capacity_factor`` for the padded (B, P) token count when
+  serving ragged MoE batches; the pads' outputs themselves are masked
+  off by the causal prefix and never affect real positions directly.)
   Right-padding works because causal attention never looks forward: real
   tokens can't see the pads, and the pad K/V beyond a row's cursor are
   masked by the causal prefix mask until generation overwrites them.
@@ -113,6 +119,7 @@ def make_generator(
     top_p: float = 0.0,
     eos_id: int | None = None,
     pad_id: int = 0,
+    with_lengths: bool = False,
 ) -> Callable:
     """Build a jitted ``gen(params, prompt, rng=None, prompt_lens=None)
     -> (B, P+max_new)``.
@@ -124,6 +131,15 @@ def make_generator(
     generated tokens, then ``pad_id`` — generation stops per row at
     ``eos_id`` (kept in the output) and the compiled loop exits early
     once every row has stopped.
+
+    ``with_lengths=True`` returns ``(tokens, gen_lens)`` with ``gen_lens``
+    (B,) int32 — the number of REAL generated tokens per row (EOS
+    included; ``max_new`` for rows that never stopped).  This is the
+    reliable way to recover per-row outputs when the vocabulary may
+    legitimately emit ``pad_id`` as an ordinary token (r4 advisor: with
+    EOS armed, a sampled pad is otherwise indistinguishable from
+    post-EOS fill — row b's generation is
+    ``tokens[b, len_b : len_b + gen_lens[b]]``).
 
     ``temperature == 0`` decodes greedily (argmax); otherwise
     logits/temperature are sampled categorically with ``rng``, optionally
@@ -224,7 +240,7 @@ def make_generator(
 
         # one decode step per iteration; early exit once every row stopped
         def cond(carry):
-            _, _, finished, _, t = carry
+            _, _, finished, _, t, _ = carry
             live = t < max_new
             if eos_id is not None:
                 live &= ~jnp.all(finished)
@@ -262,17 +278,24 @@ def make_generator(
 
             (_, _), rest = jax.lax.scan(sbody, (cache, first), rngs[1:])
             toks = jnp.concatenate([first[:, None], rest.T], axis=1)
+            flen = jnp.full((b,), max_new, jnp.int32)  # no stop: all real
         else:
             # EOS early exit needs a data-dependent loop: one decode step
-            # per iteration, done as soon as EVERY row has stopped
+            # per iteration, done as soon as EVERY row has stopped.
+            # flen records each row's real generated length (EOS slot
+            # included) the step it finishes — the per-row recovery
+            # handle when pad_id is also a legitimate vocab token.
             def body(carry):
-                cache, tok, finished, toks, t = carry
-                cache, nxt, finished = step(cache, tok, finished, rngs[t])
+                cache, tok, finished, toks, t, flen = carry
+                cache, nxt, fin2 = step(cache, tok, finished, rngs[t])
                 toks = toks.at[:, t].set(nxt)
-                return (cache, nxt, finished, toks, t + 1)
+                flen = jnp.where(fin2 & ~finished, t + 1, flen)
+                return (cache, nxt, fin2, toks, t + 1, flen)
 
-            carry = (cache, first, finished, toks, jnp.asarray(1, jnp.int32))
-            _, _, _, toks, _ = jax.lax.while_loop(cond, body, carry)
+            flen = jnp.where(finished, 1, max_new).astype(jnp.int32)
+            carry = (cache, first, finished, toks,
+                     jnp.asarray(1, jnp.int32), flen)
+            _, _, _, toks, _, flen = jax.lax.while_loop(cond, body, carry)
 
         # assemble (B, P+max_new): each row's real prompt, its generated
         # tokens at ITS length, pad everywhere else
@@ -280,9 +303,10 @@ def make_generator(
         base = jnp.where(keep, prompt, pad_id)
         out = jnp.concatenate(
             [base, jnp.full((b, max_new), pad_id, jnp.int32)], axis=1)
-        return jax.vmap(
+        out = jax.vmap(
             lambda row, g, i: jax.lax.dynamic_update_slice(row, g, (i,))
         )(out, toks, lens)
+        return (out, flen) if with_lengths else out
 
     gen._jitted = _gen  # the compiled core (tests assert its cache stays warm)
     return gen
@@ -291,7 +315,7 @@ def make_generator(
 def generate(model, params, prompt, max_new: int, max_len: int | None = None,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              rng=None, eos_id: int | None = None, pad_id: int = 0,
-             prompt_lens=None):
+             prompt_lens=None, with_lengths: bool = False):
     """One-shot convenience over :func:`make_generator` (compiles per call —
     build the generator once for repeated use, or call Trainer.generate,
     which caches it)."""
@@ -301,6 +325,7 @@ def generate(model, params, prompt, max_new: int, max_len: int | None = None,
     if max_len is None:
         max_len = int(prompt.shape[1]) + max_new
     return make_generator(model, max_len, max_new, temperature, top_k, top_p,
-                          eos_id=eos_id, pad_id=pad_id)(
+                          eos_id=eos_id, pad_id=pad_id,
+                          with_lengths=with_lengths)(
         params, prompt, rng=rng, prompt_lens=prompt_lens
     )

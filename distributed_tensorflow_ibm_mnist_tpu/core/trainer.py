@@ -169,11 +169,22 @@ class Trainer:
         # (parallel/pipeline.make_tp_block_stage_fn) when the stack is MHA.
         # The GQA q_proj/kv_proj layout has its own split; that composition
         # keeps the honest round-2 narrowing (warned below).
-        mk_hkv = int(config.model_kwargs.get("heads_kv", 0) or 0)
+        # heads_kv resolves through the family default like heads (r4
+        # advisor: kwargs-only lookup would mis-route a family that
+        # DEFAULTED heads_kv < heads onto the MHA island), and the island
+        # is claimed only when heads/dim resolve to positive values — a
+        # pp-capable family without them falls to the warned
+        # pipe-only-sharding path instead of a ZeroDivisionError in
+        # _make_pipeline_fn's dim // heads.
+        mk_hkv = int(config.model_kwargs.get(
+            "heads_kv", model_default(config.model, "heads_kv", 0) or 0) or 0)
         mk_heads = int(config.model_kwargs.get(
             "heads", model_default(config.model, "heads", 0) or 0))
+        mk_dim = int(config.model_kwargs.get(
+            "dim", model_default(config.model, "dim", 0) or 0))
         self._pp_tp_in_stages = (
             self.pp > 1 and self.tp > 1 and mk_hkv in (0, mk_heads)
+            and mk_heads > 0 and mk_dim > 0
         )
         if self._pp_tp_in_stages and mk_heads % self.tp:
             raise ValueError(
@@ -788,12 +799,13 @@ class Trainer:
         count and the nested grad-accum scan's microbatch count.  Loops whose
         bodies are not the FLOPs carrier (the epoch permutation, ring/pipeline
         inner loops at their single-chip trip counts) make this accurate for
-        the zoo's standard paths, with two documented edges: a slight
-        undercount under sp/pp islands, and with ``grad_accum > 1`` a slight
-        OVERcount — the uniform x(steps x accum) scaling also multiplies the
-        ops outside the microbatch scan (the optimizer update, counted accum-x
-        instead of once per step), which for the zoo's models is elementwise
-        work orders of magnitude below the matmul FLOPs being scaled.
+        the zoo's standard paths, with one documented edge: a slight
+        undercount under sp/pp islands.  With ``grad_accum > 1`` the uniform
+        x(steps x accum) scaling would also multiply the ops OUTSIDE the
+        microbatch scan — the optimizer update, which runs once per step,
+        not once per microbatch — so its separately-measured FLOPs are
+        subtracted back out (accum-1) times per step (round-5 verdict
+        item 7; previously a documented slight overcount).
         """
         if self._stream:
             return None
@@ -805,8 +817,34 @@ class Trainer:
         )
         if per_call is None:
             return None
-        per_epoch = per_call * self.steps_per_epoch * max(1, self.config.grad_accum)
+        accum = max(1, self.config.grad_accum)
+        per_epoch = per_call * self.steps_per_epoch * accum
+        if accum > 1:
+            opt = self._opt_update_flops()
+            if opt:
+                per_epoch -= opt * self.steps_per_epoch * (accum - 1)
         return per_epoch + self._flash_attn_flops_per_epoch()
+
+    def _opt_update_flops(self) -> float | None:
+        """FLOPs of ONE optimizer update (tx.update + apply_updates), from
+        cost analysis of the update jitted alone — the correction term for
+        ``grad_accum`` runs, where the epoch scaling would otherwise count
+        it once per microbatch.  Measured unsharded; under dp>1 the real
+        per-device update is smaller or equal, so the subtraction never
+        over-corrects by more than the (elementwise-sized) term itself.
+        """
+        import optax
+
+        from distributed_tensorflow_ibm_mnist_tpu.utils.flops import compiled_flops
+
+        def update(grads, opt_state, params):
+            updates, new_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        return compiled_flops(
+            jax.jit(update), self.state.params, self.state.opt_state,
+            self.state.params,
+        )
 
     def _flash_attn_flops_per_epoch(self) -> float:
         """Per-device analytic attention FLOPs per epoch for attn='flash'
@@ -914,12 +952,39 @@ class Trainer:
         to inference: the round-3 form ``device_put(device_get(params))``
         hauled every weight through the tunnel per call).  Invalidated by
         identity whenever training replaces ``self.state``.
+
+        Stored in the model's COMPUTE dtype (round 5): decode never
+        updates params, so the f32 master copy has no business in the
+        serving loop — the cast halves the decode copy's HBM residency
+        (a whole spare parameter set at serving scale) and removes the
+        once-per-call cast XLA otherwise hoists out of the decode loop
+        (docs/PERFORMANCE.md measures the in-loop bytes identical either
+        way).  Only leaves flax itself casts per use are converted —
+        Dense/Embed/Conv weights, ~99% of the bytes — so the cast
+        commutes exactly (f32→bf16 is the same single rounding up front
+        or per use).  LayerNorm scale/bias (``norm_*`` modules) and MoE
+        expert/router leaves (``moe``) stay f32: flax's ``_normalize``
+        and this repo's expert einsums consume them at f32 precision, so
+        pre-rounding THOSE would change decode logits vs the on_mesh
+        path's masters (code-review r5).  Integer leaves pass through.
         """
         src = self.state.params
         cached = getattr(self, "_gen_params", None)
         if cached is not None and cached[0] is src:
             return cached[1]
         tree = self._decode_param_tree()
+        dtype = self.config.model_kwargs.get(
+            "dtype", model_default(self.config.model, "dtype", jnp.bfloat16))
+
+        def cast(path, leaf):
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf
+            names = tuple(str(getattr(k, "key", k)) for k in path)
+            if any(n == "moe" or n.startswith("norm") for n in names):
+                return leaf  # consumed at param dtype — casting would drift
+            return leaf.astype(dtype)
+
+        tree = jax.tree_util.tree_map_with_path(cast, tree)
         dev = (
             next(iter(self.mesh.devices.flat)) if self.mesh is not None
             else jax.devices()[0]
@@ -957,7 +1022,8 @@ class Trainer:
     def generate(self, prompt, max_new: int, max_len: int | None = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, eos_id: int | None = None, pad_id: int = 0,
-                 prompt_lens=None, on_mesh: bool = False):
+                 prompt_lens=None, on_mesh: bool = False,
+                 with_lengths: bool = False):
         """Autoregressive decode from this run's trained weights
         (core/generate.py; causal-LM family only).
 
@@ -969,15 +1035,26 @@ class Trainer:
         compiled cache size across varying prompt lengths.  ``eos_id`` /
         ``pad_id`` / ``prompt_lens`` per :func:`~..core.generate.
         make_generator` (stop tokens, ragged right-padded prompts).
+        ``with_lengths=True`` changes the return to ``(tokens,
+        gen_lens)`` — ``gen_lens`` (B,) int32 is each row's REAL
+        generated token count (EOS included; ``max_new`` for rows that
+        never stopped), the reliable recovery handle when ``pad_id`` is
+        also a legitimate vocab token.
 
         ``on_mesh=True`` decodes IN the run's own sharded layout instead
         of re-laying out to one device: the generator jit receives the
-        tp/fsdp-sharded params as-is and GSPMD partitions the decode —
+        tp/fsdp/EP-sharded params as-is and GSPMD partitions the decode —
         qkv/head matmuls split over ``model`` (the KV cache follows the
-        activations' head sharding), fsdp layers gathered per use.  This
+        activations' head sharding), fsdp layers gathered per use, and
+        expert-parallel runs (round 5) keep each expert's weights on the
+        shard that owns them: the clean decode model's batched expert
+        einsums carry the expert-sharded ``w1/w2`` leaves, so GSPMD
+        shards them over the expert axis and reduces the combine — the
+        experts are never gathered to one device, which matters exactly
+        when "the experts don't fit one chip" is WHY the run is EP.  This
         is the multi-chip serving form: nothing is re-laid out, nothing
         crosses the host, and a pod-sized model that cannot fit one chip
-        decodes where it trained.  Requires a GSPMD run (tp/fsdp);
+        decodes where it trained.  Requires a GSPMD run (tp/fsdp/EP);
         sp-island runs decode via the default single-device path (the
         decode model drops the training islands).
         """
@@ -1000,14 +1077,14 @@ class Trainer:
                 "logits condition on future positions the decode path cannot "
                 "provide — train causally to decode"
             )
-        if on_mesh and not (self.tp > 1 or self.config.fsdp):
-            # tp/fsdp only — NOT the rest of _gspmd: sp/EP runs shard via
+        if on_mesh and not (self.tp > 1 or self.config.fsdp or self._moe_ep):
+            # tp/fsdp/EP — NOT the rest of _gspmd: sp runs shard via
             # islands the decode model drops (their param layouts have no
             # meaning to the clean decode program), and dp-replicated runs
             # gain nothing over the default path
             raise ValueError(
                 "on_mesh=True decodes in the run's GSPMD layout; this run "
-                "has none (tp/fsdp shard params — dp/sp/EP and single-chip "
+                "has none (tp/fsdp/EP shard params — dp/sp and single-chip "
                 "runs decode via the default path)"
             )
         if on_mesh and self.sp > 1:
@@ -1016,14 +1093,6 @@ class Trainer:
                 "drops the sequence-parallel islands, so its params/cache "
                 "have no 'seq' layout to decode in — use the default "
                 "single-device path"
-            )
-        if on_mesh and self._moe_ep:
-            raise ValueError(
-                "on_mesh=True with expert parallelism is unsupported: the "
-                "expert weights live in the EP island's 'data'-sharded "
-                "layout, which the clean decode program (local MoE blocks) "
-                "cannot interpret — the default path gathers them to one "
-                "device and decodes with local routing"
             )
         if on_mesh and (self.pp > 1 or self.config.model_kwargs.get("pp_stages", 0)):
             raise ValueError(
@@ -1037,7 +1106,8 @@ class Trainer:
             prompt = prompt[None, :]
         if max_len is None:
             max_len = int(prompt.shape[1]) + max_new
-        key = (max_len, max_new, temperature, top_k, top_p, eos_id, pad_id)
+        key = (max_len, max_new, temperature, top_k, top_p, eos_id, pad_id,
+               with_lengths)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -1054,7 +1124,8 @@ class Trainer:
             model = get_model(self.config.model, num_classes=self.num_classes,
                               **clean_kwargs)
             gen = make_generator(model, max_len, max_new, temperature,
-                                 top_k, top_p, eos_id=eos_id, pad_id=pad_id)
+                                 top_k, top_p, eos_id=eos_id, pad_id=pad_id,
+                                 with_lengths=with_lengths)
             cache[key] = gen
         params = self.state.params if on_mesh else self._decode_params()
         return gen(params, prompt, rng=rng, prompt_lens=prompt_lens)

@@ -214,10 +214,12 @@ class TransformerBlock(nn.Module):
         The sp/ring ``attn_fn`` islands and the flash kernel are
         training/prefill machinery; decode is bandwidth-bound
         gather-attend over the cache, which XLA handles directly (no
-        custom kernel needed at this scale).  Windowed models on the
-        uniform path gather only the live W-span of the cache per step —
-        O(W) instead of O(max_len) (the r3 advisor's noted cost);
-        full-attention and ragged decodes score the whole filled prefix.
+        custom kernel needed at this scale).  Windowed models gather
+        only the live W-span of the cache per step — O(W) instead of
+        O(max_len) (the r3 advisor's noted cost) — at a shared start on
+        the uniform path and at per-row starts (vmapped slices) on the
+        ragged path (round 5); full-attention decodes score the whole
+        filled prefix.
         """
         if max_len <= 0:
             raise ValueError("decode=True needs max_len > 0 (the KV-cache size)")
@@ -256,30 +258,41 @@ class TransformerBlock(nn.Module):
         idx_var.value = idx + s
 
         kc, vc = cache_k.value, cache_v.value
-        k_pos = jnp.arange(max_len)
-        if self.window and not ragged and (self.window + s - 1) < max_len:
+        k_pos = jnp.arange(max_len)[None]  # (1, max_len) absolute positions
+        if self.window and (self.window + s - 1) < max_len:
             # windowed decode gathers only the live span instead of
             # scoring the whole max_len cache (the O(max_len)-per-step
-            # cost noted by the r3 advisor): queries [idx0, idx0+s)
-            # attend at most positions (idx0+s-1-W, idx0+s) — a static
-            # W+s-1 span starting at max(idx0-W+1, 0).  The span's end
-            # never exceeds idx0+s <= max_len (the cache contract), so
-            # the dynamic_slice start is exact, and masking the gathered
-            # span with its true positions keeps the full-cache softmax's
-            # exact support (numerically equivalent; reduction trees over
-            # span vs max_len elements round ~1e-7 apart, so not
-            # bit-identical).  Ragged rows keep the full-cache form
-            # (per-row spans would need per-row gathers).
+            # cost noted by the r3 advisor): queries [cursor, cursor+s)
+            # attend at most positions (cursor+s-1-W, cursor+s) — a
+            # static W+s-1 span starting at max(cursor-W+1, 0).  The
+            # span's end never exceeds cursor+s <= max_len (the cache
+            # contract), so the dynamic_slice start is exact, and masking
+            # the gathered span with its true positions keeps the
+            # full-cache softmax's exact support (numerically equivalent;
+            # reduction trees over span vs max_len elements round ~1e-7
+            # apart, so not bit-identical).  Ragged rows (round 5) gather
+            # at PER-ROW starts — a vmapped dynamic_slice at each row's
+            # own cursor — so window composes with prompt_lens instead of
+            # falling back to the O(max_len) full-cache score.
             span = self.window + s - 1
-            start = jnp.maximum(idx0 - self.window + 1, 0)
-            kc = jax.lax.dynamic_slice(
-                kc, (0, start, 0, 0), (b, span, hkv, d))
-            vc = jax.lax.dynamic_slice(
-                vc, (0, start, 0, 0), (b, span, hkv, d))
-            k_pos = start + jnp.arange(span)
-        mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (B|1, S, span|max_len)
+            if ragged:
+                start = jnp.maximum(idx - self.window + 1, 0)  # (B,)
+                row_slice = jax.vmap(
+                    lambda c, st: jax.lax.dynamic_slice(
+                        c, (st, 0, 0), (span, hkv, d)))
+                kc = row_slice(kc, start)
+                vc = row_slice(vc, start)
+                k_pos = start[:, None] + jnp.arange(span)  # (B, span)
+            else:
+                start = jnp.maximum(idx0 - self.window + 1, 0)
+                kc = jax.lax.dynamic_slice(
+                    kc, (0, start, 0, 0), (b, span, hkv, d))
+                vc = jax.lax.dynamic_slice(
+                    vc, (0, start, 0, 0), (b, span, hkv, d))
+                k_pos = (start + jnp.arange(span))[None]  # (1, span)
+        mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B|1, S, span|max_len)
         if self.window:
-            mask &= k_pos[None, None, :] > q_pos[:, :, None] - self.window
+            mask &= k_pos[:, None, :] > q_pos[:, :, None] - self.window
         scale = d ** -0.5
         if hkv != h:
             # grouped einsum against the hkv-sized cache — no materialized

@@ -210,12 +210,19 @@ def make_tp_block_stage_fn(
     hl = heads // tp  # local heads per model shard
 
     def _ln(x, p):
-        x = x.astype(dtype)
-        mean = jnp.mean(x, axis=-1, keepdims=True)
+        # flax LayerNorm promotes the stats AND the normalization
+        # arithmetic to f32 (param dtype), casting to the compute dtype
+        # only on return — mirror that exactly, including the
+        # rsqrt*scale association, so the island matches the flax
+        # fallback stack at bf16 too (round-4 advisor, medium: the
+        # earlier form computed stats in ``dtype``)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.maximum(
-            jnp.mean(x * x, axis=-1, keepdims=True) - mean * mean, 0.0)
-        y = (x - mean) * jax.lax.rsqrt(var + eps)
-        return y * p["scale"].astype(dtype) + p["bias"].astype(dtype)
+            jnp.mean(xf * xf, axis=-1, keepdims=True) - mean * mean, 0.0)
+        mul = jax.lax.rsqrt(var + eps) * p["scale"].astype(jnp.float32)
+        y = (xf - mean) * mul + p["bias"].astype(jnp.float32)
+        return y.astype(dtype)
 
     def _dense(x, p):
         return x.astype(dtype) @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)

@@ -1,0 +1,192 @@
+"""Decode/serving benchmark with roofline accounting and spread reporting.
+
+The training side earned its numbers with ranges across sessions
+(BASELINE.md); this gives the serving side the same discipline (round-5
+verdict items 1 and 6):
+
+* every timing is the MEDIAN over ``--reps`` repeat calls (plus min/max),
+  with the host-side fence cost measured separately and reported — a
+  single-shot decode number on this 1-core host is unfalsifiable noise;
+* every row carries its bytes/step roofline: the parameter stream (decode
+  params are stored in the model's compute dtype — ``Trainer.
+  _decode_params``) plus the K/V cache stream, over the chip's HBM
+  bandwidth.  ``roofline_x`` = measured ms / ideal ms, the factor left on
+  the table.
+
+Decode is bandwidth-bound: one step reads every block's K/V prefix and the
+full parameter set, and does ~2 FLOPs per byte with them — so bytes/step
+over HBM bandwidth IS the floor, and the interesting output is how far
+each config sits above it.
+
+Usage:
+    python scripts/bench_decode.py [--reps 5] [--new 1024] [--hbm-gbps 819]
+Prints one JSON line per config and a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIM, DEPTH, HEADS, VOCAB = 512, 4, 8, 64
+
+
+def build_trainer(**mk):
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="bench_decode", model="causal_lm",
+        model_kwargs={"dim": DIM, "depth": DEPTH, "heads": HEADS,
+                      "attn": "flash", **mk},
+        dataset="retrieval", dataset_kwargs={"vocab": VOCAB, "seq_len": 128},
+        n_train=256, n_test=128, batch_size=64, epochs=1, quiet=True,
+    )
+    return Trainer(cfg)
+
+
+def measure_fence_s() -> float:
+    """Median cost of the timing fence itself (device_get of a ready
+    scalar through the tunnel) so per-call timings can be read net of it."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.zeros(())
+    jax.device_get(x)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(x)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def roofline_bytes(trainer, batch: int, kv_span: int, hkv: int):
+    """(param_bytes, cache_bytes) one decode step streams from HBM.
+
+    Params: the decode copy's actual leaves (compute dtype after round 5).
+    Cache: every block reads K and V over the attended span — max_len for
+    full attention, the W-span for windowed decode.  Writes (one position
+    per block) and S=1 activations are noise and not counted.
+    """
+    import jax
+
+    params = trainer._decode_params()
+    pbytes = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
+    head_dim = DIM // HEADS
+    cache_bytes = DEPTH * 2 * batch * kv_span * hkv * head_dim * 2  # bf16
+    return pbytes, cache_bytes
+
+
+def time_config(trainer, batch: int, prompt_len: int, max_new: int,
+                max_len: int, reps: int, fence_s: float, hbm_bps: float,
+                label: str, kv_span: int | None = None,
+                hkv: int | None = None, **gen_kw):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, VOCAB - 1, size=(batch, prompt_len)), jnp.int32)
+    out = trainer.generate(prompt, max_new=max_new, max_len=max_len, **gen_kw)
+    jax.device_get(jnp.sum(out))  # warmup: compile + params placement
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = trainer.generate(prompt, max_new=max_new, max_len=max_len,
+                               **gen_kw)
+        jax.device_get(jnp.sum(out))
+        ts.append(time.perf_counter() - t0)
+    med = statistics.median(ts)
+    net = max(med - fence_s, 1e-9)  # decode time net of the fence transfer
+    pbytes, cbytes = roofline_bytes(trainer, batch, kv_span or max_len,
+                                    hkv if hkv is not None else HEADS)
+    ideal_ms = (pbytes + cbytes) / hbm_bps * 1e3
+    ms_per_step = net / max_new * 1e3
+    row = {
+        "config": label, "batch": batch, "prompt_len": prompt_len,
+        "max_new": max_new, "max_len": max_len,
+        "median_s": round(med, 4), "min_s": round(min(ts), 4),
+        "max_s": round(max(ts), 4), "reps": reps,
+        "fence_s": round(fence_s, 4),
+        "tokens_per_sec": round(batch * max_new / net, 1),
+        "ms_per_step": round(ms_per_step, 4),
+        "param_mb_per_step": round(pbytes / 1e6, 2),
+        "cache_mb_per_step": round(cbytes / 1e6, 2),
+        "ideal_ms_per_step": round(ideal_ms, 4),
+        "roofline_x": round(ms_per_step / ideal_ms, 2),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--new", type=int, default=1024)
+    ap.add_argument("--hbm-gbps", type=float, default=819.0,
+                    help="HBM bandwidth (GB/s); 819 = TPU v5e")
+    ap.add_argument("--skip-window", action="store_true")
+    ap.add_argument("--big", action="store_true",
+                    help="add a serving-scale config (dim 2048, depth 6, "
+                         "~300M params) where the roofline actually binds")
+    args = ap.parse_args()
+    hbm = args.hbm_gbps * 1e9
+
+    import jax
+
+    fence = measure_fence_s()
+    print(json.dumps({"fence_s": round(fence, 4),
+                      "device": str(jax.devices()[0])}), flush=True)
+
+    rows = []
+    trainer = build_trainer()
+    for b in (1, 8, 32):
+        rows.append(time_config(trainer, b, 64, args.new, 64 + args.new,
+                                args.reps, fence, hbm, f"mha_b{b}"))
+    # ragged tax at B=8: same shapes, per-row machinery armed
+    import numpy as np
+
+    lens = np.asarray([64, 48, 32, 64, 16, 56, 40, 64], np.int32)
+    rows.append(time_config(trainer, 8, 64, args.new, 64 + args.new,
+                            args.reps, fence, hbm, "mha_b8_ragged",
+                            prompt_lens=lens))
+
+    gqa = build_trainer(heads_kv=2)
+    rows.append(time_config(gqa, 8, 64, args.new, 64 + args.new,
+                            args.reps, fence, hbm, "gqa2_b8", hkv=2))
+
+    if not args.skip_window:
+        win = build_trainer(window=1024)
+        rows.append(time_config(win, 8, 64, 2048, 8192, max(args.reps - 2, 3),
+                                fence, hbm, "win1024_b8_cache8192",
+                                kv_span=1024 + 0))
+        full = build_trainer()
+        rows.append(time_config(full, 8, 64, 2048, 8192,
+                                max(args.reps - 2, 3), fence, hbm,
+                                "full_b8_cache8192"))
+
+    if args.big:
+        # serving-scale: bytes dominate, launch overhead amortizes — this
+        # is the row where roofline_x approaches 1 (see the roofline note
+        # in docs/PERFORMANCE.md; the dim-512 rows are launch-bound)
+        global DIM, DEPTH, HEADS
+        DIM, DEPTH, HEADS = 2048, 6, 16
+        big = build_trainer()
+        for b in (1, 8):
+            rows.append(time_config(big, b, 64, 256, 320, args.reps, fence,
+                                    hbm, f"big2048_b{b}"))
+
+    print(json.dumps({"summary": {r["config"]: r["tokens_per_sec"]
+                                  for r in rows}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

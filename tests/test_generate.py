@@ -469,18 +469,11 @@ def test_generate_on_mesh_matches_single_device(eight_devices):
     leaf = jax.tree.leaves(t.state.params)[0]
     assert len(leaf.sharding.device_set) == 4
 
-    # refusals fire from config-derived state — no training needed:
-    # dp-replicated (no GSPMD layout) and EP-only (island-sharded params
-    # the clean decode model cannot interpret) are both routed away
+    # refusal fires from config-derived state — no training needed:
+    # dp-replicated runs have no GSPMD layout to decode in
     with pytest.raises(ValueError, match="on_mesh"):
         Trainer(cfg.replace(name="genmesh_dp", tp=1, dp=2)).generate(
             prompt, max_new=2, on_mesh=True)
-    cfg_ep = cfg.replace(
-        name="genmesh_ep", tp=1, dp=2,
-        model_kwargs={**cfg.model_kwargs, "moe_every": 1, "n_experts": 2},
-    )
-    with pytest.raises(ValueError, match="on_mesh"):
-        Trainer(cfg_ep).generate(prompt, max_new=2, on_mesh=True)
 
 
 def test_bf16_model_decodes():
@@ -497,23 +490,71 @@ def test_bf16_model_decodes():
     assert vars_["cache"]["block_0"]["k"].dtype == jnp.bfloat16
 
 
-def test_on_mesh_refuses_ep_with_tp(eight_devices):
-    """tp>1 alone must not admit an EP run to on_mesh decode: the expert
-    weights live in the island's 'data'-sharded layout (code-review r4)."""
+def test_on_mesh_ep_decodes_in_expert_layout(eight_devices):
+    """Multi-chip MoE serving (round 5): an EP-trained MoE LM decodes
+    on_mesh with the expert weights LEFT in their 'data'-sharded layout —
+    no gather of the experts to one device, no single-device re-layout —
+    and the tokens equal the default (gathered) path's."""
+    from distributed_tensorflow_ibm_mnist_tpu.core import trainer as trainer_mod
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+    from jax.sharding import PartitionSpec as P
+
+    cfg = RunConfig(
+        name="genmesh_ep", model="causal_lm",
+        model_kwargs={"dim": 32, "depth": 2, "heads": 2, "moe_every": 2,
+                      "n_experts": 8, "moe_capacity_factor": 8.0,
+                      "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=256, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32, dp=8,
+    )
+    t = Trainer(cfg)
+    assert t._moe_ep
+    t.fit()
+    # expert weights really are in the EP layout going in
+    w1 = t.state.params["block_1"]["moe"]["w1"]
+    assert w1.sharding.spec == P("data", None, None)
+    prompt = jnp.asarray([[2, 9, 4, 7], [1, 3, 3, 7]], jnp.int32)
+    single = t.generate(prompt, max_new=8)
+
+    t._gen_params = None
+    real_jax = trainer_mod.jax
+    trainer_mod.jax = _NoDeviceGet()
+    try:
+        meshed = t.generate(prompt, max_new=8, on_mesh=True)
+    finally:
+        trainer_mod.jax = real_jax
+    assert t._gen_params is None  # no single-device re-layout happened
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
+    # and the params STAYED in the EP layout (decode didn't re-commit them)
+    assert t.state.params["block_1"]["moe"]["w1"].sharding.spec == P(
+        "data", None, None)
+
+
+def test_on_mesh_ep_with_tp_decodes(eight_devices):
+    """EP x TP on_mesh decode: expert leaves sharded over 'data', dense
+    leaves over 'model' — GSPMD carries both layouts through the same
+    compiled generator (the round-4 refusal is lifted)."""
     from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
     from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
 
     cfg = RunConfig(
         name="genmesh_ep_tp", model="causal_lm",
         model_kwargs={"dim": 64, "depth": 2, "heads": 4, "moe_every": 2,
-                      "n_experts": 2, "dtype": jnp.float32},
+                      "n_experts": 2, "moe_capacity_factor": 8.0,
+                      "dtype": jnp.float32},
         dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
         n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
         eval_batch_size=32, tp=2, dp=2,
     )
-    with pytest.raises(ValueError, match="expert"):
-        Trainer(cfg).generate(jnp.zeros((1, 4), jnp.int32), max_new=2,
-                              on_mesh=True)
+    t = Trainer(cfg)
+    assert t._moe_ep and t.tp == 2
+    t.fit()
+    prompt = jnp.asarray([[2, 9, 4, 7]], jnp.int32)
+    single = t.generate(prompt, max_new=6)
+    meshed = t.generate(prompt, max_new=6, on_mesh=True)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
 
 
 def test_pp_trained_run_decodes(eight_devices):
@@ -608,3 +649,131 @@ def test_ep_trained_moe_lm_generates(eight_devices):
     out2 = t.generate(prompt, max_new=8)
     assert out1.shape == (1, 12)
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_ragged_windowed_decode_matches_per_row_decodes():
+    """window + prompt_lens compose (round 5): the ragged decode path
+    gathers each row's live W-span at ITS OWN cursor (vmapped
+    dynamic_slice), so a ragged windowed batch still decodes every row
+    exactly as if it were decoded alone (where the solo run takes the
+    uniform shared-start gather path — cross-path equality)."""
+    model, params = _model_and_params(seed=12, window=4)
+    prompts = [
+        jnp.asarray([[7, 3, 11, 2, 5, 1]], jnp.int32),   # len 6
+        jnp.asarray([[4, 9]], jnp.int32),                # len 2
+        jnp.asarray([[12, 1, 8, 6]], jnp.int32),         # len 4
+    ]
+    p_max, max_new = 6, 8
+    batch = jnp.zeros((3, p_max), jnp.int32)
+    for i, pr in enumerate(prompts):
+        batch = batch.at[i, : pr.shape[1]].set(pr[0])
+    lens = jnp.asarray([6, 2, 4], jnp.int32)
+
+    gen = make_generator(model, max_len=p_max + max_new, max_new=max_new)
+    out = gen(params, batch, prompt_lens=lens)
+    for i, pr in enumerate(prompts):
+        solo = generate(model, params, pr, max_new=max_new,
+                        max_len=p_max + max_new)
+        l = int(lens[i])
+        np.testing.assert_array_equal(
+            np.asarray(out[i, : l + max_new]), np.asarray(solo[0]),
+            err_msg=f"row {i} (len {l})",
+        )
+        assert (np.asarray(out[i, l + max_new:]) == 0).all()
+
+
+def test_with_lengths_reports_real_generated_lengths():
+    """with_lengths=True returns per-row generated lengths (EOS included;
+    max_new for rows that never stop) — the reliable recovery handle when
+    pad_id is also a legitimate vocab token (r4 advisor)."""
+    model, params = _model_and_params(seed=13)
+    prompt = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+    max_new = 10
+
+    # no EOS: every row generates exactly max_new
+    toks, lens = make_generator(
+        model, max_len=16, max_new=max_new, with_lengths=True)(params, prompt)
+    assert toks.shape == (2, 14)
+    np.testing.assert_array_equal(np.asarray(lens), [max_new, max_new])
+
+    # EOS armed: lengths equal each row's first emission of it (+1), and
+    # match what the free run predicts
+    free = np.asarray(make_generator(model, max_len=16, max_new=max_new)(
+        params, prompt))
+    eos = int(free[0, 4 + 2])
+    pad = int(eos == 0)
+    toks, lens = make_generator(
+        model, max_len=16, max_new=max_new, eos_id=eos, pad_id=pad,
+        with_lengths=True)(params, prompt)
+    lens = np.asarray(lens)
+    for row in range(2):
+        hits = np.nonzero(free[row, 4:] == eos)[0]
+        expect = int(hits[0]) + 1 if hits.size else max_new
+        assert lens[row] == expect, f"row {row}: {lens[row]} != {expect}"
+        # the row's REAL generation is recoverable even if it contains pad
+        np.testing.assert_array_equal(
+            np.asarray(toks[row, 4:4 + lens[row]]),
+            free[row, 4:4 + expect])
+
+
+def test_on_mesh_compositions_match_single_device(eight_devices):
+    """on_mesh x {ragged+EOS, sampled, bf16} on tp=4 (round-5 verdict
+    item 8): each composition must produce the same tokens as the
+    default single-device path on the same trained state."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="genmesh_comp", model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 1, "heads": 4,
+                      "dtype": jnp.bfloat16},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32, tp=4,
+    )
+    t = Trainer(cfg)
+    t.fit()
+
+    # bf16 + ragged + EOS: per-row machinery through the GSPMD layout
+    ragged = jnp.asarray([[2, 9, 4, 7], [1, 3, 0, 0]], jnp.int32)
+    lens = jnp.asarray([4, 2], jnp.int32)
+    kw = dict(max_new=6, eos_id=1, pad_id=0, prompt_lens=lens)
+    single = t.generate(ragged, **kw)
+    meshed = t.generate(ragged, on_mesh=True, **kw)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
+
+    # sampled: same rng must sample the same tokens through both layouts
+    kw = dict(max_new=6, temperature=0.8, top_k=8,
+              rng=jax.random.PRNGKey(3))
+    single = t.generate(ragged[:1], **kw)
+    meshed = t.generate(ragged[:1], on_mesh=True, **kw)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
+
+
+def test_on_mesh_fsdp_decodes(eight_devices):
+    """fsdp on_mesh decode (claimed in the generate docstring since round
+    4, tested nowhere until round 5): the ZeRO-3 'data'-sharded params
+    feed the generator as-is and the tokens equal the default path's."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="genmesh_fsdp", model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 2, "heads": 4,
+                      "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32, dp=8, fsdp=True,
+    )
+    t = Trainer(cfg)
+    assert t.config.fsdp
+    t.fit()
+    # at least one leaf is really fsdp-sharded going in
+    specs = {tuple(l.sharding.spec) for l in jax.tree.leaves(t.state.params)}
+    assert any("data" in s for s in specs if s), specs
+    prompt = jnp.asarray([[2, 9, 4, 7]], jnp.int32)
+    single = t.generate(prompt, max_new=6)
+    t._gen_params = None
+    meshed = t.generate(prompt, max_new=6, on_mesh=True)
+    assert t._gen_params is None  # no single-device re-layout happened
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))

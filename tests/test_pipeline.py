@@ -303,3 +303,39 @@ def test_pp_x_tp_island_matches_pp_only_trajectory(eight_devices):
     a, b = jax.device_get((t1.state.params, t2.state.params))
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-3)
+
+
+def test_pp_x_tp_island_matches_pp_only_trajectory_bf16(eight_devices):
+    """The bf16 variant of the trajectory equivalence (r4 advisor,
+    medium): the island's LayerNorm computes stats and normalization in
+    f32 exactly like flax — at the zoo's DEFAULT compute dtype the
+    island and the flax fallback stack (same stored params) must stay on
+    the same trajectory.  Tolerances are bf16-scale."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    def run(tp):
+        cfg = RunConfig(
+            name=f"pptpb16_{tp}", model="causal_lm",
+            model_kwargs={"dim": 32, "depth": 4, "heads": 4,
+                          "dtype": jnp.bfloat16},
+            dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+            n_train=128, n_test=32, batch_size=32, epochs=2, quiet=True,
+            eval_batch_size=32, dp=1, pp=2, tp=tp, seed=5,
+        )
+        t = Trainer(cfg)
+        t.fit()
+        return t
+
+    t1 = run(1)
+    t2 = run(2)
+    assert t2._pp_tp_in_stages
+    losses1 = [r["train_loss"] for r in t1.history]
+    losses2 = [r["train_loss"] for r in t2.history]
+    np.testing.assert_allclose(losses1, losses2, rtol=5e-2)
+    a, b = jax.device_get((t1.state.params, t2.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32), atol=5e-2)
