@@ -59,23 +59,40 @@ import jax
 import jax.numpy as jnp
 
 
-def _cache_from_sown(intermediates, lens, max_len: int):
+def _cache_from_sown(intermediates, lens, max_len: int,
+                     kv_cache_dtype: str = "native"):
     """Assemble the decode-cache pytree from the K/V each block sowed
     during the forward prefill: pad (B, P, H_kv, D) to the max_len cache
     and set every block's (B,) write cursor to the per-row prompt length
     (pad K/V beyond a row's length stay in the cache but sit above its
-    cursor, so the causal mask hides them until decode overwrites them)."""
+    cursor, so the causal mask hides them until decode overwrites them).
+    ``kv_cache_dtype="int8"`` quantizes the sown K/V into the int8+scales
+    layout the quantized decode cache uses (models/transformer.py
+    ``quantize_kv_int8``) — the prefill itself still ran full-precision."""
     cache = {}
     for name, sub in intermediates.items():
         if "kv_cache" not in sub:
             continue
         k, v = sub["kv_cache"][0]
         pad = ((0, 0), (0, max_len - k.shape[1]), (0, 0), (0, 0))
-        cache[name] = {
-            "k": jnp.pad(k, pad),
-            "v": jnp.pad(v, pad),
+        entry = {
             "index": jnp.broadcast_to(lens, (k.shape[0],)).astype(jnp.int32),
         }
+        if kv_cache_dtype == "int8":
+            from distributed_tensorflow_ibm_mnist_tpu.models.transformer import (
+                quantize_kv_int8,
+            )
+
+            k_q, k_s = quantize_kv_int8(k)
+            v_q, v_s = quantize_kv_int8(v)
+            entry["k"] = jnp.pad(k_q, pad)
+            entry["v"] = jnp.pad(v_q, pad)
+            entry["k_scale"] = jnp.pad(k_s, pad[:3])
+            entry["v_scale"] = jnp.pad(v_s, pad[:3])
+        else:
+            entry["k"] = jnp.pad(k, pad)
+            entry["v"] = jnp.pad(v, pad)
+        cache[name] = entry
     if not cache:
         raise ValueError(
             "prefill sowed no K/V — the model must pass sow_kv through to "
@@ -227,7 +244,9 @@ def make_generator(
         logits, vars_ = model.apply(
             {"params": params}, prompt, mutable=["intermediates"],
         )
-        cache = _cache_from_sown(vars_["intermediates"], lens, max_len)
+        cache = _cache_from_sown(
+            vars_["intermediates"], lens, max_len,
+            getattr(model, "kv_cache_dtype", "native"))
         # each row's first sample comes from ITS last real position
         last = jnp.take_along_axis(
             logits, (lens - 1)[:, None, None], axis=1)[:, 0]  # (B, V)

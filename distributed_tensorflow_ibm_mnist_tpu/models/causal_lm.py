@@ -60,6 +60,9 @@ class CausalLM(nn.Module):
     #   length into the checkpoint; kept for ablation) | 'none'
     sow_kv: bool = False  # sow per-block K/V on the normal forward (the
     #   flash-prefill capture; core/generate.py clones the model with this)
+    kv_cache_dtype: str = "native"  # "int8": quantized decode cache with
+    #   per-(position, head) scales — halves the decode's dominant HBM
+    #   stream (models/transformer.quantize_kv_int8); training is untouched
     tie_embeddings: bool = False  # share the token embedding with the
     #   output head (logits = x @ embed^T): V*dim fewer params, the
     #   standard small-LM regularizer.  The Megatron rule's feature-dim
@@ -166,7 +169,8 @@ class CausalLM(nn.Module):
                 n_experts=self.n_experts, moe_capacity_factor=self.moe_capacity_factor,
                 moe_top_k=self.moe_top_k, moe_z_weight=self.moe_z_weight,
                 moe_fn=self.moe_fn, rope=rope, sow_kv=self.sow_kv,
-                window=self.window, dtype=self.dtype, name=f"block_{i}",
+                window=self.window, kv_cache_dtype=self.kv_cache_dtype,
+                dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         if self.tie_embeddings:

@@ -70,6 +70,27 @@ def apply_rope(x: jnp.ndarray, theta: float = 10000.0, offset=0) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
+def quantize_kv_int8(x):
+    """Symmetric per-(token, head) int8 quantization for the decode cache:
+    ``scale = max|x| / 127`` over the head_dim axis, so each cached
+    position/head pair carries one f32 scale (1/D the cache's own bytes)
+    and the (B, max_len, H_kv, D) payload stores int8 — HALF the HBM
+    stream of a bf16 cache, the bandwidth-bound decode's next constant
+    factor after GQA (round-5 verdict item 10).
+
+    The scale factors NEVER multiply the cache payload on the read side:
+    scores dequantize per (q, k) PAIR (``scores *= k_scale``) and the PV
+    contraction folds ``v_scale`` into the probabilities — both D-times
+    smaller than dequantizing the cache itself, so the int8 stream rides
+    into the MXU through a fused convert.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.round(xf / scale[..., None])
+    return q.astype(jnp.int8), scale
+
+
 def _resolve_attn(attn_fn: Callable | None, attn: str) -> Callable:
     """attn_fn (explicit callable, e.g. a ring-attention island) wins; else
     pick by name: 'vanilla' (XLA) or 'flash' (the Pallas kernel) — a string
@@ -113,6 +134,8 @@ class TransformerBlock(nn.Module):
     #   prompt through the ordinary (flash) attention and assembles the
     #   decode cache from these, instead of attending over the max_len
     #   cache (O(S*max_len) scores, OOM for long prompts)
+    kv_cache_dtype: str = "native"  # "native" (= dtype) | "int8": quantized
+    #   decode cache with per-(position, head) scales — see quantize_kv_int8
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -223,12 +246,26 @@ class TransformerBlock(nn.Module):
         """
         if max_len <= 0:
             raise ValueError("decode=True needs max_len > 0 (the KV-cache size)")
+        if self.kv_cache_dtype not in ("native", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'native' or 'int8', got "
+                f"{self.kv_cache_dtype!r}"
+            )
         b, s, h, d = q.shape
         hkv = k.shape[2]  # GQA: the cache is heads_kv-sized — the memory win
+        quant = self.kv_cache_dtype == "int8"
+        store = jnp.int8 if quant else self.dtype
         cache_k = self.variable(
-            "cache", "k", lambda: jnp.zeros((b, max_len, hkv, d), self.dtype))
+            "cache", "k", lambda: jnp.zeros((b, max_len, hkv, d), store))
         cache_v = self.variable(
-            "cache", "v", lambda: jnp.zeros((b, max_len, hkv, d), self.dtype))
+            "cache", "v", lambda: jnp.zeros((b, max_len, hkv, d), store))
+        if quant:
+            scale_k = self.variable(
+                "cache", "k_scale",
+                lambda: jnp.zeros((b, max_len, hkv), jnp.float32))
+            scale_v = self.variable(
+                "cache", "v_scale",
+                lambda: jnp.zeros((b, max_len, hkv), jnp.float32))
         idx_var = self.variable(
             "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
         idx = idx_var.value  # (B,) per-row decode cursor
@@ -239,25 +276,42 @@ class TransformerBlock(nn.Module):
                 q = apply_rope(q, offset=idx)
                 k = apply_rope(k, offset=idx)
             row_update = jax.vmap(
-                lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0)))
-            cache_k.value = row_update(
-                cache_k.value, k.astype(cache_k.value.dtype), idx)
-            cache_v.value = row_update(
-                cache_v.value, v.astype(cache_v.value.dtype), idx)
+                lambda c, u, i: jax.lax.dynamic_update_slice(
+                    c, u, (i,) + (0,) * (c.ndim - 1)))
+            if quant:
+                k_st, k_sc = quantize_kv_int8(k)
+                v_st, v_sc = quantize_kv_int8(v)
+                scale_k.value = row_update(scale_k.value, k_sc, idx)
+                scale_v.value = row_update(scale_v.value, v_sc, idx)
+            else:
+                k_st, v_st = k.astype(store), v.astype(store)
+            cache_k.value = row_update(cache_k.value, k_st, idx)
+            cache_v.value = row_update(cache_v.value, v_st, idx)
             q_pos = idx[:, None] + jnp.arange(s)  # (B, S) absolute positions
         else:
             idx0 = idx[0]  # uniform rows: ONE cursor, one slice update
             if self.rope:
                 q = apply_rope(q, offset=idx0)
                 k = apply_rope(k, offset=idx0)
+            if quant:
+                k_st, k_sc = quantize_kv_int8(k)
+                v_st, v_sc = quantize_kv_int8(v)
+                scale_k.value = jax.lax.dynamic_update_slice(
+                    scale_k.value, k_sc, (0, idx0, 0))
+                scale_v.value = jax.lax.dynamic_update_slice(
+                    scale_v.value, v_sc, (0, idx0, 0))
+            else:
+                k_st, v_st = k.astype(store), v.astype(store)
             cache_k.value = jax.lax.dynamic_update_slice(
-                cache_k.value, k.astype(cache_k.value.dtype), (0, idx0, 0, 0))
+                cache_k.value, k_st, (0, idx0, 0, 0))
             cache_v.value = jax.lax.dynamic_update_slice(
-                cache_v.value, v.astype(cache_v.value.dtype), (0, idx0, 0, 0))
+                cache_v.value, v_st, (0, idx0, 0, 0))
             q_pos = (idx0 + jnp.arange(s))[None]  # (1, S) broadcasts over B
         idx_var.value = idx + s
 
         kc, vc = cache_k.value, cache_v.value
+        ksc = scale_k.value if quant else None
+        vsc = scale_v.value if quant else None
         k_pos = jnp.arange(max_len)[None]  # (1, max_len) absolute positions
         if self.window and (self.window + s - 1) < max_len:
             # windowed decode gathers only the live span instead of
@@ -279,9 +333,13 @@ class TransformerBlock(nn.Module):
                 start = jnp.maximum(idx - self.window + 1, 0)  # (B,)
                 row_slice = jax.vmap(
                     lambda c, st: jax.lax.dynamic_slice(
-                        c, (st, 0, 0), (span, hkv, d)))
+                        c, (st,) + (0,) * (c.ndim - 1),
+                        (span,) + c.shape[1:]))
                 kc = row_slice(kc, start)
                 vc = row_slice(vc, start)
+                if quant:
+                    ksc = row_slice(ksc, start)
+                    vsc = row_slice(vsc, start)
                 k_pos = start[:, None] + jnp.arange(span)  # (B, span)
             else:
                 start = jnp.maximum(idx0 - self.window + 1, 0)
@@ -289,31 +347,52 @@ class TransformerBlock(nn.Module):
                     kc, (0, start, 0, 0), (b, span, hkv, d))
                 vc = jax.lax.dynamic_slice(
                     vc, (0, start, 0, 0), (b, span, hkv, d))
+                if quant:
+                    ksc = jax.lax.dynamic_slice(
+                        ksc, (0, start, 0), (b, span, hkv))
+                    vsc = jax.lax.dynamic_slice(
+                        vsc, (0, start, 0), (b, span, hkv))
                 k_pos = (start + jnp.arange(span))[None]  # (1, span)
         mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B|1, S, span|max_len)
         if self.window:
             mask &= k_pos[:, None, :] > q_pos[:, :, None] - self.window
         scale = d ** -0.5
+        # int8 cache: the payload converts to the compute dtype INSIDE the
+        # contraction (a fused convert — the HBM stream stays int8-sized)
+        # and the scales apply at (q, k)-pair granularity: scores pick up
+        # k_scale per key position, probabilities fold v_scale before the
+        # PV contraction — both D-times cheaper than dequantizing the
+        # cache, and the softmax sees exactly the dequantized scores.
+        kc_op = kc.astype(self.dtype) if quant else kc
+        vc_op = vc.astype(self.dtype) if quant else vc
         if hkv != h:
             # grouped einsum against the hkv-sized cache — no materialized
             # repeat (the smaller cache bandwidth IS the GQA decode win)
             qg = q.reshape(b, s, hkv, h // hkv, d)
             scores = jnp.einsum(
-                "bqhgd,bkhd->bhgqk", qg, kc,
+                "bqhgd,bkhd->bhgqk", qg, kc_op,
                 preferred_element_type=jnp.float32) * scale
+            if quant:
+                scores = scores * ksc.transpose(0, 2, 1)[:, :, None, None, :]
             scores = jnp.where(mask[:, None, None], scores, -1e30)
             p = jax.nn.softmax(scores, axis=-1)
+            if quant:
+                p = p * vsc.transpose(0, 2, 1)[:, :, None, None, :]
             out = jnp.einsum(
-                "bhgqk,bkhd->bqhgd", p.astype(vc.dtype), vc,
+                "bhgqk,bkhd->bqhgd", p.astype(self.dtype), vc_op,
                 preferred_element_type=jnp.float32).reshape(b, s, h, d)
         else:
             scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, kc,
+                "bqhd,bkhd->bhqk", q, kc_op,
                 preferred_element_type=jnp.float32) * scale
+            if quant:
+                scores = scores * ksc.transpose(0, 2, 1)[:, :, None, :]
             scores = jnp.where(mask[:, None], scores, -1e30)
             p = jax.nn.softmax(scores, axis=-1)
+            if quant:
+                p = p * vsc.transpose(0, 2, 1)[:, :, None, :]
             out = jnp.einsum(
-                "bhqk,bkhd->bqhd", p.astype(vc.dtype), vc,
+                "bhqk,bkhd->bqhd", p.astype(self.dtype), vc_op,
                 preferred_element_type=jnp.float32)
         return out.astype(self.dtype)
 

@@ -57,6 +57,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
+_LOG2E = 1.4426950408889634  # exp(x) == exp2(x * log2(e)): the kernels run
+#   the online softmax in BASE 2 — the multiply folds into the score scale
+#   (one constant fold instead of one VPU multiply per element next to the
+#   EUP exponential), and lse converts back to natural log at finalize so
+#   the fwd/bwd contract (p = exp(scores - lse)) is unchanged.
+_LN2 = 0.6931471805599453
 
 # Default VMEM tile sizes (q rows x k cols per inner step).  Swept on the
 # v5e at B=4 S=8192 H=8 D=64 causal bf16 (scripts/bench_flash.py): larger
@@ -88,8 +94,23 @@ _FWD_BLOCK_K = 1024
 # bf16 needs 3 MB and compiles at ~11 MB scoped; S=16384 needs 6.3 MB
 # and was MEASURED to blow the scoped limit (20.5 MB requested — the
 # row buffer plus the intermediates don't co-fit), so rows past the
-# 4 MB line take the two-kernel fallback.
+# 4 MB line take the GROUPED fused path below (round 5; previously the
+# two-kernel fallback).
 _FUSED_DQ_VMEM_BUDGET = 4 * 1024 * 1024
+
+# Long rows past the gate use the GROUPED fused backward (round 5): the
+# q rows are split into VMEM-sized groups, each walking all k-tiles, with
+# per-group partial dK/dV summed outside the kernel.  False falls back to
+# the round-3 two-kernel scheme (kept for A/B and as the escape hatch).
+_GROUPED_BWD = True
+
+# The grouped path's dq group budget is SMALLER than the fused gate: its
+# f32 partial dK/dV output blocks cost ~1 MB of scoped VMEM the fused
+# layout's bf16 outputs don't — measured: sizing groups against the full
+# 4 MB budget requested 16.93 MB of the 16 MB scoped limit at S=16384
+# (956 KB over), so the group sizing budget drops to 2.5 MB, which the
+# chip accepts with headroom.
+_GROUPED_DQ_VMEM_BUDGET = int(2.5 * 1024 * 1024)
 
 
 def _on_tpu() -> bool:
@@ -111,23 +132,68 @@ def _dot(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
-def _run_live_tiles(causal, qi, ki, block_q, block_k, compute, window=0):
-    """Execute ``compute`` only on live tiles: at-or-below the causal
-    diagonal, and (with ``window`` > 0, sliding-window attention) within
-    ``window`` positions of it.  MUST mirror the clamp formulas in
-    _kv_spec/_q_side_spec: a dead step's operand refs point at a live
-    tile (so Pallas skips the DMA), and this gate skips the compute that
-    would otherwise read that stale block."""
+# Interior-tile mask elision (round 5): when False, every live tile runs
+# the masked body — the pre-round-5 behavior, kept togglable so
+# scripts/bench_flash.py can A/B the split in one session.
+_SPLIT_INTERIOR = True
+
+
+def _run_tiles(causal, qi, ki, block_q, block_k, compute, window=0,
+               pad_ok=True):
+    """Dispatch each grid step to the right body: skip dead tiles, and run
+    INTERIOR tiles — tiles whose mask would be all-true — through the
+    mask-free body (round 5: the iota/compare/select chain on a
+    (Bq, Bk) tile runs only where the tile actually crosses the causal
+    diagonal / window edge / sequence padding.  Measured a WASH at the
+    1024-tile S=8192 causal headline shape — Mosaic evidently prices the
+    mask chain below timing noise there — and kept because it is free,
+    reads as documentation of which tiles need masking, and bounds the
+    mask cost at small tiles; see docs/PERFORMANCE.md round-5 notes).
+
+    ``compute`` is called as ``compute(masked=...)`` with a PYTHON bool —
+    the kernel builds its mask only in the boundary instantiation.
+    ``pad_ok`` is the caller's this-tile-needs-no-padding-mask condition:
+    ``True`` (static) when the sequence is unpadded, else a traced
+    per-step bool.
+
+    Liveness MUST mirror the clamp formulas in _kv_spec/_q_side_spec: a
+    dead step's operand refs point at a live tile (so Pallas skips the
+    DMA), and this gate skips the compute that would otherwise read that
+    stale block."""
     if causal:
         live = (qi + 1) * block_q > ki * block_k
+        below = (ki + 1) * block_k <= qi * block_q
         if window:
             live &= (ki + 1) * block_k + window - 2 >= qi * block_q
+            # fully inside the window: the tile's SMALLEST k position is
+            # within reach of its LARGEST q position
+            below &= ki * block_k >= qi * block_q + block_q - window
+        if not _SPLIT_INTERIOR:
+            @pl.when(live)
+            def _legacy():
+                compute(masked=True)
+            return
+        interior = below if pad_ok is True else below & pad_ok
 
-        @pl.when(live)
-        def _run():
-            compute()
+        @pl.when(live & interior)
+        def _interior():
+            compute(masked=False)
+
+        @pl.when(live & jnp.logical_not(interior))
+        def _boundary():
+            compute(masked=True)
+    elif pad_ok is True and _SPLIT_INTERIOR:
+        compute(masked=False)
+    elif not _SPLIT_INTERIOR:
+        compute(masked=True)
     else:
-        compute()
+        @pl.when(pad_ok)
+        def _interior():
+            compute(masked=False)
+
+        @pl.when(jnp.logical_not(pad_ok))
+        def _boundary():
+            compute(masked=True)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
@@ -142,25 +208,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    def _compute():
+    def _compute(masked):
         q = q_ref[0]  # (Bq, D), input dtype
         k = k_ref[0]  # (Bk, D)
         v = v_ref[0]
         tq, bk = q.shape[0], k.shape[0]
-        scores = _dot(q, k, (((1,), (1,)))) * sm_scale  # (Bq, Bk) f32
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
-        mask = k_pos < s_real
-        if causal:
-            mask = mask & (k_pos <= q_pos)
-            if window:
-                mask = mask & (k_pos > q_pos - window)
-        scores = jnp.where(mask, scores, _NEG)
+        # base-2 online softmax: log2(e) folded into the score scale
+        scores = _dot(q, k, (((1,), (1,)))) * (sm_scale * _LOG2E)
+        if masked:  # boundary tiles only — interior masks are all-true
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (tq, bk), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (tq, bk), 1)
+            mask = k_pos < s_real
+            if causal:
+                mask = mask & (k_pos <= q_pos)
+                if window:
+                    mask = mask & (k_pos > q_pos - window)
+            scores = jnp.where(mask, scores, _NEG)
 
         m_prev, l_prev, acc_prev = m_sc[...], l_sc[...], acc_sc[...]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(scores - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         m_sc[...] = m_new
         l_sc[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_sc[...] = acc_prev * corr + _dot(p.astype(v.dtype), v, ((1,), (0,)))
@@ -170,15 +240,56 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
     # (see _flash_fwd), so Pallas sees an unchanged block index and issues
     # NO DMA — the round-2 rejection (860 ms gated vs 720 ms ungated)
     # gated the body but left the BlockSpec walking dead tiles, paying the
-    # copies anyway.  Dead steps now cost only grid-step overhead.
-    _run_live_tiles(causal, qi, ki, block_q, block_k, _compute, window)
+    # copies anyway.  Dead steps now cost only grid-step overhead; interior
+    # steps skip the mask build entirely (round 5).
+    pad_ok = True if s_real == n_k * block_k else (ki + 1) * block_k <= s_real
+    _run_tiles(causal, qi, ki, block_q, block_k, _compute, window, pad_ok)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
         l = l_sc[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padding) rows
         o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = m_sc[...] + jnp.log(l_safe)
+        # m is in base-2 units; lse stays NATURAL log (the bwd contract)
+        lse_ref[0] = m_sc[...] * _LN2 + jnp.log(l_safe)
+
+
+def _bwd_tile_chain(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+                    *, sm_scale, block_q, block_k, s_real, causal, window,
+                    masked, mask_q_pad):
+    """The shared backward recompute chain for one (q-tile, k-tile) pair:
+    scores -> p (base-2 recompute against the saved row lse) -> dp -> ds.
+    Each backward kernel accumulates its OWN gradients from the returned
+    operands; the chain itself exists once (code-review r5 — the base-2
+    and mask-elision changes previously had to be replicated into four
+    kernel bodies).  ``masked`` is the boundary-tile instantiation;
+    ``mask_q_pad`` says whether the mask must also cover pad q rows (the
+    dK/dV-accumulating kernels — pad rows carry garbage lse; dq-only
+    kernels discard pad rows' output downstream instead)."""
+    k = k_ref[0]   # (Bk, D), input dtype
+    v = v_ref[0]
+    q = q_ref[0]   # (Bq, D)
+    do = do_ref[0]
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    bq, bk = q.shape[0], k.shape[0]
+    # base-2 recompute: log2(e) folded into the score scale (see fwd)
+    scores = _dot(q, k, ((1,), (1,))) * (sm_scale * _LOG2E)
+    p = jnp.exp2(scores - lse * _LOG2E)  # recomputed probs, f32
+    if masked:  # boundary tiles only — interior masks are all-true
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = k_pos < s_real
+        if mask_q_pad:
+            mask = mask & (q_pos < s_real)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+            if window:
+                mask = mask & (k_pos > q_pos - window)
+        p = jnp.where(mask, p, 0.0)
+    dp = _dot(do, v, ((1,), (1,)))  # (Bq, Bk) f32
+    ds = p * (dp - delta) * sm_scale
+    return p, ds, q, k, do
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
@@ -192,31 +303,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    def _compute():
-        k = k_ref[0]   # (Bk, D), input dtype
-        v = v_ref[0]
-        q = q_ref[0]   # (Bq, D)
-        do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        bq, bk = q.shape[0], k.shape[0]
-        scores = _dot(q, k, ((1,), (1,))) * sm_scale  # (Bq, Bk) f32
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (k_pos < s_real) & (q_pos < s_real)
-        if causal:
-            mask = mask & (k_pos <= q_pos)
-            if window:
-                mask = mask & (k_pos > q_pos - window)
-        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed probs, f32
+    def _compute(masked):
+        p, ds, q, _, do = _bwd_tile_chain(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            s_real=s_real, causal=causal, window=window, masked=masked,
+            mask_q_pad=True)
         dv_sc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
-        dp = _dot(do, v, ((1,), (1,)))  # (Bq, Bk) f32
-        ds = p * (dp - delta) * sm_scale
         dk_sc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
     # causal skip: see the gating note in _fwd_kernel (same live condition;
-    # here the q index maps are clamped instead of the K/V ones)
-    _run_live_tiles(causal, qi, ki, block_q, block_k, _compute, window)
+    # here the q index maps are clamped instead of the K/V ones).  The
+    # backward's padding mask covers BOTH sides (pad q rows carry garbage
+    # lse), so interior needs the q-tile clear of the padding too.
+    pad_ok = (
+        True if s_real == n_q * block_q
+        else ((ki + 1) * block_k <= s_real) & ((qi + 1) * block_q <= s_real)
+    )
+    _run_tiles(causal, qi, ki, block_q, block_k, _compute, window, pad_ok)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -255,32 +359,23 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    def _compute():
-        k = k_ref[0]   # (Bk, D), input dtype
-        v = v_ref[0]
-        q = q_ref[0]   # (Bq, D)
-        do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        bq, bk = q.shape[0], k.shape[0]
-        scores = _dot(q, k, ((1,), (1,))) * sm_scale  # (Bq, Bk) f32
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        k_pos = ji * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (k_pos < s_real) & (q_pos < s_real)
-        if causal:
-            mask = mask & (k_pos <= q_pos)
-            if window:
-                mask = mask & (k_pos > q_pos - window)
-        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)  # recomputed ONCE
+    def _compute(masked):
+        p, ds, q, k, do = _bwd_tile_chain(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ji,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            s_real=s_real, causal=causal, window=window, masked=masked,
+            mask_q_pad=True)
         dv_sc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
-        dp = _dot(do, v, ((1,), (1,)))  # (Bq, Bk) f32
-        ds = p * (dp - delta) * sm_scale
         dk_sc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
         dq_sc[qi] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     # causal skip: see the gating note in _fwd_kernel (dead steps skip the
     # compute AND the clamped q-side index maps elide their DMAs)
-    _run_live_tiles(causal, qi, ji, block_q, block_k, _compute, window)
+    pad_ok = (
+        True if s_real == n_q * block_q
+        else ((ji + 1) * block_k <= s_real) & ((qi + 1) * block_q <= s_real)
+    )
+    _run_tiles(causal, qi, ji, block_q, block_k, _compute, window, pad_ok)
 
     @pl.when(qi == n_q - 1)
     def _flush_dkv():
@@ -288,6 +383,67 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
     @pl.when((ji == n_k - 1) & (qi == n_q - 1))
+    def _flush_dq():
+        dq_ref[0] = dq_sc[...].reshape(dq_ref.shape[1:]).astype(dq_ref.dtype)
+
+
+def _grouped_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, dq_sc, *,
+                        sm_scale, block_q, block_k, n_qg, n_k, n_q, s_real,
+                        causal, window):
+    """The fused backward with a q-row-GROUP outer grid dim (round 5) —
+    the long-row form of :func:`_fused_bwd_kernel`.
+
+    The one-walk kernel is gated on dQ's whole row fitting VMEM
+    (``_FUSED_DQ_VMEM_BUDGET``); past the gate, rows are split into
+    ``G = n_q / n_qg`` groups and the grid becomes (bh, group, k-tile,
+    q-tile-in-group) — each group walks ALL k-tiles against its own
+    block of q rows, so its dQ scratch is bounded at (n_qg, block_q, D)
+    and flushes once per group.  dK/dV still accumulate per k-tile
+    inside a group, but now arrive in G per-group PARTIAL outputs
+    (shape (bh, G, S_pad, D), block index (b_, g, j)) summed outside
+    the kernel — an output block may only be revisited on consecutive
+    grid steps, so cross-group accumulation cannot happen in scratch.
+    Costs vs the one-walk form: K/V are swept once per group instead of
+    once (the group-clamped index maps elide the sweeps a causal
+    group's diagonal never reaches), plus the (G-1) extra partial-sum
+    arrays; still ONE scores/p/ds recompute per live tile vs the
+    two-kernel fallback's two.
+    """
+    g, ji, i = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    qi = g * n_qg + i  # global q-tile id (liveness/masks use this)
+
+    @pl.when((ji == 0) & (i == 0))
+    def _init_dq():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    @pl.when(i == 0)
+    def _init_dkv():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    def _compute(masked):
+        p, ds, q, k, do = _bwd_tile_chain(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ji,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            s_real=s_real, causal=causal, window=window, masked=masked,
+            mask_q_pad=True)
+        dv_sc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
+        dk_sc[...] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
+        dq_sc[i] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
+
+    pad_ok = (
+        True if s_real == n_q * block_q
+        else ((ji + 1) * block_k <= s_real) & ((qi + 1) * block_q <= s_real)
+    )
+    _run_tiles(causal, qi, ji, block_q, block_k, _compute, window, pad_ok)
+
+    @pl.when(i == n_qg - 1)
+    def _flush_dkv():
+        dk_ref[0, 0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[...].astype(dv_ref.dtype)
+
+    @pl.when((ji == n_k - 1) & (i == n_qg - 1))
     def _flush_dq():
         dq_ref[0] = dq_sc[...].reshape(dq_ref.shape[1:]).astype(dq_ref.dtype)
 
@@ -301,29 +457,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    def _compute():
-        q = q_ref[0]  # (Bq, D), input dtype
-        do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        tq, bk = q.shape[0], k.shape[0]
-        scores = _dot(q, k, ((1,), (1,))) * sm_scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
-        mask = k_pos < s_real
-        if causal:
-            mask = mask & (k_pos <= q_pos)
-            if window:
-                mask = mask & (k_pos > q_pos - window)
-        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
-        dp = _dot(do, v, ((1,), (1,)))
-        ds = p * (dp - delta) * sm_scale
+    def _compute(masked):
+        _, ds, _, k, _ = _bwd_tile_chain(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+            sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            s_real=s_real, causal=causal, window=window, masked=masked,
+            mask_q_pad=False)
         dq_sc[...] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
-    # causal skip: see the gating note in _fwd_kernel
-    _run_live_tiles(causal, qi, ki, block_q, block_k, _compute, window)
+    # causal skip: see the gating note in _fwd_kernel.  dq's mask has no
+    # q-side term (pad rows' dq is garbage sliced off by the caller), so
+    # interior needs only the k-tile clear of the padding — but pad q rows
+    # DO carry lse=0, whose exp(scores) stays finite and is discarded.
+    pad_ok = True if s_real == n_k * block_k else (ki + 1) * block_k <= s_real
+    _run_tiles(causal, qi, ki, block_q, block_k, _compute, window, pad_ok)
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -434,6 +581,20 @@ def _fused_grid_params(interpret):
         "interpret": False,
         "compiler_params": pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+    }
+
+
+def _grouped_grid_params(interpret):
+    # 4-D grid (bh, group, k-tile, q-tile-in-group); the dq/dk/dv scratch
+    # accumulations span the non-leading dims, so only bh parallelizes
+    if interpret:
+        return {"interpret": True}
+    return {
+        "interpret": False,
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "arbitrary", "arbitrary", "arbitrary"),
         ),
     }
 
@@ -559,6 +720,78 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret, window=0):
             ],
             **_fused_grid_params(interpret),
         )(qp, kp, vp, gp, lse, delta)
+        return from_bh(dq_p, h), from_bh_grouped(dk_p), from_bh_grouped(dv_p)
+
+    # GROUPED fused path (round 5): rows past the VMEM gate split into
+    # budget-sized q-row groups — see _grouped_bwd_kernel.  One recompute
+    # per live tile at the cost of G-1 extra K/V sweeps and per-group
+    # partial dK/dV summed here.
+    budget_rows = _GROUPED_DQ_VMEM_BUDGET // (d * (4 + jnp.dtype(q.dtype).itemsize))
+    n_qg = min(n_q, max(1, budget_rows // block_q))
+    while n_q % n_qg:
+        n_qg -= 1
+    if _GROUPED_BWD and n_q // n_qg >= 2:
+        n_groups = n_q // n_qg
+        group_rows = n_qg * block_q
+        g_fold = h // hkv
+
+        def q_side_map(b_, g, j, i):
+            ii = g * n_qg + i
+            if causal:
+                ii = jnp.maximum(ii, (j * block_k) // block_q)
+                if window:
+                    ii = jnp.minimum(
+                        ii, ((j + 1) * block_k + window - 2) // block_q)
+            return (b_, ii, 0)
+
+        def kv_map(b_, g, j, i):
+            kv_row = (b_ // h) * hkv + (b_ % h) // g_fold
+            jj = j
+            if causal:
+                # a causal group's diagonal never reaches k tiles past its
+                # own last row: clamp so those sweeps are DMA-elided
+                jj = jnp.minimum(
+                    jj, ((g + 1) * n_qg * block_q - 1) // block_k)
+                if window:
+                    jj = jnp.maximum(jj, jnp.maximum(
+                        0, (g * n_qg * block_q - window + 1) // block_k))
+            return (kv_row, jj, 0)
+
+        qspec = pl.BlockSpec((1, block_q, d), q_side_map)
+        sspec = pl.BlockSpec((1, block_q, 1), q_side_map)
+        kvspec = pl.BlockSpec((1, block_k, d), kv_map)
+        dq_p, dk_g, dv_g = pl.pallas_call(
+            partial(_grouped_bwd_kernel, sm_scale=sm_scale, block_q=block_q,
+                    block_k=block_k, n_qg=n_qg, n_k=n_k, n_q=n_q,
+                    s_real=s, causal=causal, window=window),
+            grid=(bh, n_groups, n_k, n_qg),
+            in_specs=[qspec, kvspec, kvspec, qspec, sspec, sspec],
+            out_specs=[
+                pl.BlockSpec((1, group_rows, d),
+                             lambda b_, g, j, i: (b_, g, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda b_, g, j, i: (b_, g, j, 0)),
+                pl.BlockSpec((1, 1, block_k, d),
+                             lambda b_, g, j, i: (b_, g, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sp, d), q.dtype),
+                # partials stay f32 so dK/dV see ONE final rounding after
+                # the cross-group sum, matching the fused and two-kernel
+                # schemes' gradient precision (code-review r5); the cost
+                # is a transient G-sized f32 array pair, freed at the sum
+                jax.ShapeDtypeStruct((bh, n_groups, sp, d), jnp.float32),
+                jax.ShapeDtypeStruct((bh, n_groups, sp, d), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),        # dk tile
+                pltpu.VMEM((block_k, d), jnp.float32),        # dv tile
+                pltpu.VMEM((n_qg, block_q, d), jnp.float32),  # dq group rows
+            ],
+            **_grouped_grid_params(interpret),
+        )(qp, kp, vp, gp, lse, delta)
+        dk_p = dk_g.sum(axis=1).astype(q.dtype)
+        dv_p = dv_g.sum(axis=1).astype(v.dtype)
         return from_bh(dq_p, h), from_bh_grouped(dk_p), from_bh_grouped(dv_p)
 
     # dK/dV are produced PER Q-HEAD (shape B*H like q) and group-reduced

@@ -146,13 +146,44 @@ def permute_qkv_head_major(stacked, heads: int, head_dim: int):
     )
 
 
+def permute_kv_shard_major(stacked, heads_kv: int, head_dim: int, tp: int):
+    """Reorder the GQA ``kv_proj`` projection's output features so a
+    contiguous tp-way column split hands each shard its own complete
+    (K heads, V heads) pair (round 5 — the GQA analog of
+    :func:`permute_qkv_head_major`).
+
+    flax's fused ``kv_proj`` Dense lays its ``2*heads_kv*head_dim`` output
+    features (k|v)-major — ``flat = (c*heads_kv + h)*head_dim + d`` — so a
+    contiguous split gives shard 0 "all of K plus some of V".  This
+    relayout blocks the features by SHARD — ``(tp, 2, heads_kv/tp,
+    head_dim)``-major — after which each shard's contiguous chunk is
+    locally (k|v)-major over its own ``heads_kv/tp`` kv heads, exactly
+    the layout the island's local ``reshape(b, s, 2, hkv_local, d)``
+    expects.  ``q_proj``/``proj`` need no permute: their features are
+    already head-major.  Same per-step cost note as the qkv permute.
+    """
+    hkv_l = heads_kv // tp
+
+    def fix(path, leaf):
+        if "kv_proj" not in path:
+            return leaf
+        lead = leaf.shape[:-1]
+        x = leaf.reshape(*lead, 2, tp, hkv_l, head_dim)
+        x = jnp.swapaxes(x, -4, -3)  # (..., tp, 2, hkv_l, head_dim)
+        return x.reshape(*lead, 2 * heads_kv * head_dim)
+
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, v: fix(tuple(getattr(k, "key", k) for k in kp), v), stacked
+    )
+
+
 def tp_stage_specs(stacked, tp_axis: str = "model", axis: str = AXIS):
     """Per-leaf island PartitionSpecs for a stacked TransformerBlock tree
     under pp x tp: stage dim over ``pipe`` everywhere, plus the Megatron
     dims over ``model`` — qkv/dense_0 column-parallel (last dim), proj/
     dense_1 row-parallel (second-to-last), LayerNorms replicated.
     Leaves are ``(n_stages, per_stage, ...)``."""
-    col = {"qkv", "dense_0"}
+    col = {"qkv", "q_proj", "kv_proj", "dense_0"}
     row = {"proj", "dense_1"}
 
     def spec(path, leaf):
@@ -181,6 +212,7 @@ def make_tp_block_stage_fn(
     tp_axis: str = "model",
     eps: float = 1e-6,
     block_remat: bool = False,
+    heads_kv: int = 0,
 ):
     """Explicit-collective Megatron TransformerBlock stack for pp x tp.
 
@@ -202,12 +234,25 @@ def make_tp_block_stage_fn(
     Returns ``stage_fn(local_stage_params, h)`` for
     :func:`make_pipeline_apply` with ``param_specs=tp_stage_specs(...)``;
     ``local_stage_params`` leaves are ``(1, per_stage, ...)`` slices.
-    MHA only (the GQA q/kv split has its own projection layout; the
-    trainer refuses that composition).
+
+    ``heads_kv`` (round 5) arms the GQA form: the stack's separate
+    ``q_proj``/``kv_proj`` projections split column-parallel — q heads
+    contiguously (already head-major), kv heads via the shard-major
+    relayout (:func:`permute_kv_shard_major`) — and the grouping stays
+    LOCAL to each shard: shard s owns q heads [s*heads/tp, ...) and kv
+    heads [s*heads_kv/tp, ...), and ``q_head // (heads/heads_kv)`` lands
+    inside the shard's own kv block exactly when tp divides heads_kv
+    (the trainer gates on that).
     """
     if heads % tp:
         raise ValueError(f"heads ({heads}) must divide by tp ({tp})")
+    if heads_kv and (heads_kv % tp or heads % heads_kv):
+        raise ValueError(
+            f"GQA pp x tp needs tp ({tp}) | heads_kv ({heads_kv}) and "
+            f"heads_kv | heads ({heads})"
+        )
     hl = heads // tp  # local heads per model shard
+    hkv_l = (heads_kv // tp) if heads_kv else 0
 
     def _ln(x, p):
         # flax LayerNorm promotes the stats AND the normalization
@@ -230,9 +275,15 @@ def make_tp_block_stage_fn(
     def block(p, x):
         b, s, dim = x.shape
         h = _ln(x, p["norm_attn"])
-        qkv = _dense(h, p["qkv"])  # (B, S, hl*3*head_dim), head-major layout
-        qkv = qkv.reshape(b, s, hl, 3, head_dim)
-        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        if heads_kv:
+            # GQA: separate projections, both column-split by head blocks
+            q = _dense(h, p["q_proj"]).reshape(b, s, hl, head_dim)
+            kv = _dense(h, p["kv_proj"]).reshape(b, s, 2, hkv_l, head_dim)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+        else:
+            qkv = _dense(h, p["qkv"])  # (B, S, hl*3*head_dim), head-major
+            qkv = qkv.reshape(b, s, hl, 3, head_dim)
+            q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         if rope:
             from distributed_tensorflow_ibm_mnist_tpu.models.transformer import apply_rope
 

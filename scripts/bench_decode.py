@@ -72,15 +72,21 @@ def roofline_bytes(trainer, batch: int, kv_span: int, hkv: int):
 
     Params: the decode copy's actual leaves (compute dtype after round 5).
     Cache: every block reads K and V over the attended span — max_len for
-    full attention, the W-span for windowed decode.  Writes (one position
-    per block) and S=1 activations are noise and not counted.
+    full attention, the W-span for windowed decode; int8 caches stream 1
+    byte/element plus the per-(position, head) f32 scales.  Writes (one
+    position per block) and S=1 activations are noise and not counted.
     """
     import jax
 
     params = trainer._decode_params()
     pbytes = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
     head_dim = DIM // HEADS
-    cache_bytes = DEPTH * 2 * batch * kv_span * hkv * head_dim * 2  # bf16
+    if trainer.config.model_kwargs.get("kv_cache_dtype") == "int8":
+        per_elem = 1
+        scales = DEPTH * 2 * batch * kv_span * hkv * 4
+    else:
+        per_elem, scales = 2, 0  # bf16
+    cache_bytes = DEPTH * 2 * batch * kv_span * hkv * head_dim * per_elem + scales
     return pbytes, cache_bytes
 
 
@@ -172,6 +178,11 @@ def main() -> None:
         rows.append(time_config(full, 8, 64, 2048, 8192,
                                 max(args.reps - 2, 3), fence, hbm,
                                 "full_b8_cache8192"))
+        # int8 KV cache at the same cache-dominated shape (round 5)
+        i8 = build_trainer(kv_cache_dtype="int8")
+        rows.append(time_config(i8, 8, 64, 2048, 8192,
+                                max(args.reps - 2, 3), fence, hbm,
+                                "int8_b8_cache8192"))
 
     if args.big:
         # serving-scale: bytes dominate, launch overhead amortizes — this

@@ -91,10 +91,12 @@ def test_as_vit_attn_fn():
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_fused_bwd_matches_two_kernel_fallback(causal, monkeypatch):
-    """The fused one-walk backward (r4) and the two-kernel long-row
-    fallback are the same math: forcing the VMEM gate to 0 must reproduce
-    identical grads (GQA included, so the group reduction is covered on
-    both paths)."""
+    """All three backward schemes are the same math: the fused one-walk
+    (r4), the GROUPED fused long-row form (r5 — q-row groups with
+    per-group partial dK/dV summed outside), and the two-kernel fallback.
+    Forcing the VMEM gate to 0 routes to the grouped path; disabling it
+    routes to the two-kernel scheme; all must reproduce identical grads
+    (GQA included, so the group reduction is covered on every path)."""
     from distributed_tensorflow_ibm_mnist_tpu.ops import flash_attention as fa
 
     q, _, _ = _qkv(b=2, s=40, h=4, d=16, seed=3)
@@ -110,8 +112,41 @@ def test_fused_bwd_matches_two_kernel_fallback(causal, monkeypatch):
     g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     assert fa._FUSED_DQ_VMEM_BUDGET > 0  # default really takes the fused path
     monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_BUDGET", 0)
+    assert fa._GROUPED_BWD
+    g_grouped = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)  # grouped path
+    monkeypatch.setattr(fa, "_GROUPED_BWD", False)
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)  # two-kernel path
+    for name, a, b, c in zip("qkv", g_fused, g_grouped, g_split):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), atol=1e-5, err_msg=f"fused {name}"
+        )
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(c), atol=1e-5, err_msg=f"grouped {name}"
+        )
+
+
+@pytest.mark.parametrize("window", [0, 24])
+def test_grouped_bwd_long_row_matches_two_kernel(window, monkeypatch):
+    """The grouped fused backward at a MULTI-GROUP shape (several q-row
+    groups, several k-tiles per group, causal + sliding-window clamps
+    armed) reproduces the two-kernel scheme's grads exactly."""
+    from distributed_tensorflow_ibm_mnist_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(b=1, s=256, h=2, d=16, seed=6)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, window=window) ** 2)
+
+    # tiles of 32x32 -> n_q=8; budget = 64 f32+f32 rows of d=16 -> 2-tile
+    # groups -> G=4
+    monkeypatch.setattr(fa, "_BLOCK_Q", 32)
+    monkeypatch.setattr(fa, "_BLOCK_K", 32)
+    monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_BUDGET", 64 * 16 * (4 + 4))
+    g_grouped = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(fa, "_GROUPED_BWD", False)
     g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    for name, a, b in zip("qkv", g_fused, g_split):
+    for name, a, b in zip("qkv", g_grouped, g_split):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5, err_msg=name
         )

@@ -241,7 +241,8 @@ def test_pp_x_tp_inside_stages_no_warning_and_trains(eight_devices):
     s = t.fit()
     assert np.isfinite(s["best_test_accuracy"])
 
-    # the GQA stack has its own projection layout: narrowing + warning stay
+    # aligned GQA stacks (tp | heads_kv) run the island since round 5 —
+    # no warning; an UNALIGNED heads_kv keeps the honest narrowing
     gqa = RunConfig(
         name="pptpg", model="causal_lm",
         model_kwargs={"dim": 32, "depth": 2, "heads": 4, "heads_kv": 2,
@@ -253,7 +254,17 @@ def test_pp_x_tp_inside_stages_no_warning_and_trains(eight_devices):
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         tg = Trainer(gqa)
-    assert not tg._pp_tp_in_stages
+    assert tg._pp_tp_in_stages
+    assert not any("NOT tensor-parallel" in str(x.message) for x in w)
+
+    unaligned = gqa.replace(
+        name="pptpg_u", dp=1, tp=4, pp=2,
+        model_kwargs={**gqa.model_kwargs, "heads_kv": 2},
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tu = Trainer(unaligned)
+    assert not tu._pp_tp_in_stages
     assert any("NOT tensor-parallel" in str(x.message) for x in w)
 
     # heads must divide tp on the explicit path
@@ -339,3 +350,37 @@ def test_pp_x_tp_island_matches_pp_only_trajectory_bf16(eight_devices):
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(
             np.asarray(x, np.float32), np.asarray(y, np.float32), atol=5e-2)
+
+
+def test_pp_x_tp_gqa_island_matches_pp_only_trajectory(eight_devices):
+    """The GQA pp x tp island (round 5): q_proj split by q-head blocks,
+    kv_proj by the shard-major kv relayout (permute_kv_shard_major), the
+    grouping local to each shard — pp=2 x tp=2 must track the pp-only
+    trajectory of the SAME seed exactly like the MHA test above."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    def run(tp):
+        cfg = RunConfig(
+            name=f"pptpgqa{tp}", model="causal_lm",
+            model_kwargs={"dim": 32, "depth": 4, "heads": 4, "heads_kv": 2,
+                          "dtype": jnp.float32},
+            dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+            n_train=128, n_test=32, batch_size=32, epochs=2, quiet=True,
+            eval_batch_size=32, dp=1, pp=2, tp=tp, seed=7,
+        )
+        t = Trainer(cfg)
+        t.fit()
+        return t
+
+    t1 = run(1)
+    t2 = run(2)
+    assert t2._pp_tp_in_stages
+    losses1 = [r["train_loss"] for r in t1.history]
+    losses2 = [r["train_loss"] for r in t2.history]
+    np.testing.assert_allclose(losses1, losses2, rtol=2e-3)
+    a, b = jax.device_get((t1.state.params, t2.state.params))
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-3)
