@@ -12,6 +12,39 @@ from __future__ import annotations
 
 import flax.linen as nn
 import jax.numpy as jnp
+import numpy as np
+
+
+def _polyphase_maps(k: int = 5, f: int = 2):
+    """Static index/validity maps turning a (k, k, 1, C) stride-1 SAME conv
+    kernel into its stride-``f`` polyphase form: a (k2, k2, f*f, f*f*C)
+    kernel over the space-to-depth input, where k2 = k//f + 1.
+
+    Polyphase identity: writing an output row ``o = f*i + d`` and an input
+    offset ``t = d + u - k//2 = f*p + s`` (s in [0, f)), the 5x5 C_in=1
+    conv decomposes exactly into f*f phase kernels of spatial extent k2
+    over the f*f space-to-depth channels.  Returned as numpy constants so
+    the per-step work is ONE gather+mask of the stored (5,5,1,C) kernel —
+    checkpoints and the parameter layout are untouched.
+    """
+    half, k2 = k // 2, k // f + 1
+    U = np.zeros((k2, k2, f * f, f * f), np.int32)
+    V = np.zeros_like(U)
+    OK = np.zeros(U.shape, bool)
+    for d_i in range(f):
+        for d_j in range(f):
+            for p in range(k2):
+                for q in range(k2):
+                    for s_u in range(f):
+                        for s_v in range(f):
+                            u = f * (p - 1) + s_u + half - d_i
+                            v = f * (q - 1) + s_v + half - d_j
+                            ci, co = s_u * f + s_v, d_i * f + d_j
+                            if 0 <= u < k and 0 <= v < k:
+                                U[p, q, ci, co] = u
+                                V[p, q, ci, co] = v
+                                OK[p, q, ci, co] = True
+    return U, V, OK
 
 
 class LeNet5(nn.Module):
@@ -19,12 +52,27 @@ class LeNet5(nn.Module):
 
     num_classes: int = 10
     dropout_rate: float = 0.5
+    conv1_s2d: bool = False  # exact polyphase space-to-depth form of conv1:
+    #   the C_in=1 5x5 conv wastes the MXU's reduction AND output lanes
+    #   (4.5% of FLOPs, ~39% of step time — docs/PERFORMANCE.md); this
+    #   computes the SAME function as one 3x3 conv with C_in=4, C_out=128
+    #   over the pixel-unshuffled image, from the SAME stored (5,5,1,32)
+    #   parameters (a static gather re-expresses the kernel per step).
+    #   MEASURED REJECTION on the v5e bench condition (round 5, in-session
+    #   A/B): 601.5k -> 425.0k img/s — the pixel-shuffle relayouts of the
+    #   (B, 28, 28, 32) activations cost more than the 4x lane occupancy
+    #   buys at these shapes, the same lesson as the round-2 im2col
+    #   rejection.  Kept off by default; exact-equivalence test pins it.
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
+        if self.conv1_s2d:
+            x = self._conv1_polyphase(x)
+        else:
+            x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype,
+                        name="conv1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype, name="conv2")(x)
@@ -36,3 +84,56 @@ class LeNet5(nn.Module):
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
         return x.astype(jnp.float32)
+
+    def _conv1_polyphase(self, x):
+        """conv1 evaluated in its stride-2 polyphase form (see conv1_s2d):
+        a submodule NAMED "conv1" with the identical (5,5,1,32)+(32,)
+        parameter layout, so checkpoints interchange with the direct
+        form; equivalence pinned by test_lenet_conv1_s2d_matches_direct.
+        """
+        return _PolyphaseConv1(dtype=self.dtype, name="conv1")(x)
+
+
+class _PolyphaseConv1(nn.Module):
+    """The LeNet conv1 (5x5, C_in=1, SAME) computed as one 3x3 conv with
+    C_in=4, C_out=128 over the pixel-unshuffled image — the SAME function
+    from the SAME stored parameters (a static gather re-expresses the
+    kernel per step; the 14x14 SAME conv's zero padding corresponds
+    exactly to the original padding rows).  C_in=1 fills 1/128 of the
+    MXU's reduction lanes and C_out=32 a quarter of its output lanes;
+    the polyphase form trades 1.44x the FLOPs for 4x both occupancies.
+    """
+
+    features: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        import jax
+
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (5, 5, 1, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        b, h, w_, _ = x.shape
+        hh, ww = h // 2, w_ // 2
+        U, V, OK = _polyphase_maps()
+        # (3, 3, 4, 4, C): phase kernels gathered from the stored weights
+        wsd = jnp.where(
+            jnp.asarray(OK)[..., None],
+            kernel[jnp.asarray(U), jnp.asarray(V), 0, :],
+            0.0,
+        ).astype(self.dtype)
+        wsd = wsd.reshape(3, 3, 4, 4 * self.features)
+        xs = x.reshape(b, hh, 2, ww, 2).transpose(0, 1, 3, 2, 4)
+        xs = xs.reshape(b, hh, ww, 4).astype(self.dtype)
+        # no preferred_element_type: XLA accumulates bf16 convs in f32 on
+        # TPU anyway, and an f32 OUTPUT would hand the backward's conv
+        # transpose mixed-dtype operands (f32 cotangent x bf16 kernel),
+        # which lax.conv refuses; this matches flax Conv's own lowering
+        y = jax.lax.conv_general_dilated(
+            xs, wsd, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y.reshape(b, hh, ww, 2, 2, self.features)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(b, h, w_, self.features)
+        return (y + bias).astype(self.dtype)

@@ -507,6 +507,32 @@ def _prepare(q, k, v):
             (b, s, h, d, hkv))
 
 
+def _clamp_k_tile(kk, q_lo, q_hi, block_k: int, window: int):
+    """DMA-elision clamp for a K/V tile index against the q rows
+    [q_lo, q_hi] it serves: never past the causal diagonal's last live
+    tile, and (with a sliding ``window``) never before the first
+    in-window tile.  The SINGLE home of this formula — the per-q-tile
+    BlockSpecs and the grouped path's per-group maps both call it
+    (code-review r5: four inline copies had to stay mirrored by hand).
+    MUST stay the dual of _run_tiles' liveness conditions."""
+    kk = jnp.minimum(kk, q_hi // block_k)
+    if window:
+        kk = jnp.maximum(
+            kk, jnp.maximum(0, (q_lo - window + 1) // block_k))
+    return kk
+
+
+def _clamp_q_tile(ii, k_lo, k_hi, block_q: int, window: int):
+    """The q-side dual of :func:`_clamp_k_tile` for dK/dV-layout walks:
+    clamp a q tile index against the k rows [k_lo, k_hi] — dead leading
+    q-tiles clamp UP to the k-tile's first live q-tile, and with a
+    window dead TRAILING q-tiles clamp DOWN to the last in-window one."""
+    ii = jnp.maximum(ii, k_lo // block_q)
+    if window:
+        ii = jnp.minimum(ii, (k_hi + window - 1) // block_q)
+    return ii
+
+
 def _kv_spec(block_k: int, d: int, h: int, hkv: int, k_axis: int,
              causal_clamp_bq: int = 0, window: int = 0):
     """BlockSpec for a K/V operand under grouped heads: grid dim 0 runs
@@ -516,10 +542,10 @@ def _kv_spec(block_k: int, d: int, h: int, hkv: int, k_axis: int,
 
     ``causal_clamp_bq`` (the q block size; fwd/dq layouts only) arms the
     causal tile-skip: dead above-diagonal steps get their k index CLAMPED
-    to the last live tile, so Pallas sees an unchanged block index and
-    skips the DMA entirely while the kernel body skips the compute — the
-    mechanism that makes the skip actually pay (see the gating note in
-    _fwd_kernel)."""
+    to the last live tile (:func:`_clamp_k_tile`), so Pallas sees an
+    unchanged block index and skips the DMA entirely while the kernel
+    body skips the compute — the mechanism that makes the skip actually
+    pay (see the gating note in _fwd_kernel)."""
     g = h // hkv
 
     def index_map(b_, i, j):
@@ -527,14 +553,9 @@ def _kv_spec(block_k: int, d: int, h: int, hkv: int, k_axis: int,
         kk = j if k_axis == 2 else i
         if causal_clamp_bq:
             qi = i if k_axis == 2 else j
-            kk = jnp.minimum(kk, ((qi + 1) * causal_clamp_bq - 1) // block_k)
-            if window:
-                # sliding window: dead leading tiles clamp UP to the first
-                # in-window tile (same no-DMA mechanism)
-                kk = jnp.maximum(
-                    kk, jnp.maximum(
-                        0, (qi * causal_clamp_bq - window + 1) // block_k)
-                )
+            kk = _clamp_k_tile(kk, qi * causal_clamp_bq,
+                               (qi + 1) * causal_clamp_bq - 1, block_k,
+                               window)
         return (kv_row, kk, 0)
 
     return pl.BlockSpec((1, block_k, d), index_map)
@@ -551,10 +572,8 @@ def _q_side_spec(block_q: int, d_or_1: int, block_k: int,
     def index_map(b_, j, i):
         ii = i
         if causal_clamp:
-            ii = jnp.maximum(ii, (j * block_k) // block_q)
-            if window:
-                ii = jnp.minimum(
-                    ii, ((j + 1) * block_k + window - 2) // block_q)
+            ii = _clamp_q_tile(ii, j * block_k, (j + 1) * block_k - 1,
+                               block_q, window)
         return (b_, ii, 0)
 
     return pl.BlockSpec((1, block_q, d_or_1), index_map)
@@ -738,10 +757,8 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret, window=0):
         def q_side_map(b_, g, j, i):
             ii = g * n_qg + i
             if causal:
-                ii = jnp.maximum(ii, (j * block_k) // block_q)
-                if window:
-                    ii = jnp.minimum(
-                        ii, ((j + 1) * block_k + window - 2) // block_q)
+                ii = _clamp_q_tile(ii, j * block_k, (j + 1) * block_k - 1,
+                                   block_q, window)
             return (b_, ii, 0)
 
         def kv_map(b_, g, j, i):
@@ -749,12 +766,11 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret, window=0):
             jj = j
             if causal:
                 # a causal group's diagonal never reaches k tiles past its
-                # own last row: clamp so those sweeps are DMA-elided
-                jj = jnp.minimum(
-                    jj, ((g + 1) * n_qg * block_q - 1) // block_k)
-                if window:
-                    jj = jnp.maximum(jj, jnp.maximum(
-                        0, (g * n_qg * block_q - window + 1) // block_k))
+                # own last row: the same clamp at GROUP granularity elides
+                # those whole sweeps
+                jj = _clamp_k_tile(jj, g * n_qg * block_q,
+                                   (g + 1) * n_qg * block_q - 1, block_k,
+                                   window)
             return (kv_row, jj, 0)
 
         qspec = pl.BlockSpec((1, block_q, d), q_side_map)

@@ -68,6 +68,18 @@ def main():
     print("ragged row 0 (len 8):", np.asarray(out[0]))
     print("ragged row 1 (len 3):", np.asarray(out[1]))
 
+    # with_lengths=True returns each row's REAL generated length (EOS
+    # included) — the reliable recovery handle when pad_id can also be
+    # sampled as an ordinary token (round 5).
+    out, lens = trainer.generate(ragged, max_new=16, eos_id=2, pad_id=0,
+                                 prompt_lens=jnp.asarray([8, 3], jnp.int32),
+                                 with_lengths=True)
+    print("generated lengths:", np.asarray(lens))
+
+    # int8 KV cache (round 5): halve the decode cache's HBM stream with a
+    # tested logit-drift bound — a RunConfig knob, everything else equal:
+    #   RunConfig(..., model_kwargs={..., "kv_cache_dtype": "int8"})
+
 
 if __name__ == "__main__":
     main()

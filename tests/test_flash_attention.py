@@ -111,9 +111,21 @@ def test_fused_bwd_matches_two_kernel_fallback(causal, monkeypatch):
 
     g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     assert fa._FUSED_DQ_VMEM_BUDGET > 0  # default really takes the fused path
+    # Route to the GROUPED path for real: zeroing the fused gate alone is
+    # not enough (the grouped group-size budget could still cover every
+    # q-tile, degenerating to the two-kernel fallback — code-review r5),
+    # so shrink the group budget to one tile per group AND spy the kernel.
     monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_BUDGET", 0)
+    monkeypatch.setattr(fa, "_GROUPED_DQ_VMEM_BUDGET", 8 * 16 * 8)
     assert fa._GROUPED_BWD
+    grouped_ran = []
+    orig_kernel = fa._grouped_bwd_kernel
+    monkeypatch.setattr(
+        fa, "_grouped_bwd_kernel",
+        lambda *a, **kw: (grouped_ran.append(1), orig_kernel(*a, **kw))[1],
+    )
     g_grouped = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)  # grouped path
+    assert grouped_ran, "grouped backward was not actually exercised"
     monkeypatch.setattr(fa, "_GROUPED_BWD", False)
     g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)  # two-kernel path
     for name, a, b, c in zip("qkv", g_fused, g_grouped, g_split):
@@ -138,12 +150,20 @@ def test_grouped_bwd_long_row_matches_two_kernel(window, monkeypatch):
         return jnp.sum(
             flash_attention(q, k, v, causal=True, window=window) ** 2)
 
-    # tiles of 32x32 -> n_q=8; budget = 64 f32+f32 rows of d=16 -> 2-tile
-    # groups -> G=4
+    # tiles of 32x32 -> n_q=8; the fused gate rejects the row, and the
+    # grouped budget sizes 2-tile groups -> G=4 (spied to prove routing)
     monkeypatch.setattr(fa, "_BLOCK_Q", 32)
     monkeypatch.setattr(fa, "_BLOCK_K", 32)
-    monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_BUDGET", 64 * 16 * (4 + 4))
+    monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_BUDGET", 0)
+    monkeypatch.setattr(fa, "_GROUPED_DQ_VMEM_BUDGET", 64 * 16 * (4 + 4))
+    grouped_ran = []
+    orig_kernel = fa._grouped_bwd_kernel
+    monkeypatch.setattr(
+        fa, "_grouped_bwd_kernel",
+        lambda *a, **kw: (grouped_ran.append(1), orig_kernel(*a, **kw))[1],
+    )
     g_grouped = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert grouped_ran, "grouped backward was not actually exercised"
     monkeypatch.setattr(fa, "_GROUPED_BWD", False)
     g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for name, a, b in zip("qkv", g_grouped, g_split):

@@ -75,3 +75,28 @@ def test_gradients_finite():
         assert np.all(np.isfinite(g))
     # at least one nonzero gradient leaf
     assert any(np.abs(g).sum() > 0 for g in jax.tree.leaves(grads))
+
+
+def test_lenet_conv1_s2d_matches_direct():
+    """The polyphase space-to-depth conv1 (round 5) is the SAME function
+    as the direct 5x5 C_in=1 conv, from the SAME parameter layout —
+    checkpoints interchange between the two forms."""
+    import jax
+    import numpy as np
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+
+    direct = get_model("lenet5", num_classes=10, dtype=jnp.float32,
+                       dropout_rate=0.0)
+    poly = get_model("lenet5", num_classes=10, dtype=jnp.float32,
+                     dropout_rate=0.0, conv1_s2d=True)
+    x = jnp.asarray(
+        np.random.default_rng(0).random((4, 28, 28, 1)), jnp.float32)
+    params = direct.init(jax.random.PRNGKey(0), x, train=False)["params"]
+    # identical param trees: the polyphase form declares conv1/kernel+bias
+    p2 = poly.init(jax.random.PRNGKey(0), x, train=False)["params"]
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(p2)
+    assert params["conv1"]["kernel"].shape == p2["conv1"]["kernel"].shape
+
+    a = direct.apply({"params": params}, x, train=False)
+    b = poly.apply({"params": params}, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

@@ -202,14 +202,17 @@ cfg = RunConfig(
     name="lm_golden", model="causal_lm",
     model_kwargs={"dim": 128, "depth": 2, "heads": 4, "attn": "flash"},
     dataset="retrieval", dataset_kwargs={"vocab": 64, "seq_len": 1024},
-    n_train=2048, n_test=64, batch_size=16, epochs=5, lr=3e-3, causal=True,
-    quiet=True, eval_batch_size=16, eval_every=5,
+    n_train=2048, n_test=64, batch_size=16, epochs=7, lr=3e-3, causal=True,
+    quiet=True, eval_batch_size=16, eval_every=7,
 )
 t = Trainer(cfg)
 s = t.fit()
 losses = [h["train_loss"] for h in t.history]
-# uniform floor = ln(64) = 4.16; the attend-to-key head must have emerged
-assert losses[-1] < 3.0, losses
+# uniform floor = ln(64) = 4.16; the attend-to-key head must have emerged.
+# 7 epochs, not 5: emergence epoch is rounding-sensitive (the round-5
+# base-2 softmax shifted it from ~5 to ~6 — measured 2.41 at 6, 1.95 at
+# 7), so the budget leaves margin on both sides of the threshold.
+assert losses[-1] < 2.8, losses
 assert s["tokens_per_sec_per_chip"] > 50_000, s
 print("LM_GOLDEN_OK", losses[-1], s["tokens_per_sec_per_chip"], flush=True)
 '''
