@@ -137,6 +137,7 @@ def make_generator(
     eos_id: int | None = None,
     pad_id: int = 0,
     with_lengths: bool = False,
+    unroll: int = 1,
 ) -> Callable:
     """Build a jitted ``gen(params, prompt, rng=None, prompt_lens=None)
     -> (B, P+max_new)``.
@@ -164,6 +165,11 @@ def make_generator(
     smallest set reaching p probability mass).  The returned callable is
     compiled once per (prompt length, batch) shape; reuse it across calls
     (Trainer.generate caches it for you).
+
+    ``unroll`` replicates the decode-scan body and applies ONLY to the
+    ``eos_id=None`` scan path (the EOS early-exit while_loop cannot
+    unroll); measured a rejection on the v5e (see the in-body note) and
+    kept at 1 there — the knob exists for other hardware.
     """
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
@@ -289,13 +295,21 @@ def make_generator(
 
         if eos_id is None:
             # static trip count -> lax.scan (XLA pipelines it measurably
-            # better than the equivalent while_loop: ~8% at B=32)
+            # better than the equivalent while_loop: ~8% at B=32).
+            # ``unroll`` replicates the step body — tried against the
+            # kernel-launch-bound small-model decode (the roofline note in
+            # docs/PERFORMANCE.md) and MEASURED a rejection on the v5e:
+            # B=1 +3% at unroll=8, B=8 −23% at unroll>=4 (each step's
+            # cache dynamic_update_slice chain serializes, so unrolling
+            # only bloats the program).  Kept at 1; the knob remains for
+            # other hardware.
             def sbody(carry, step_rng):
                 cache, tok = carry
                 cache, nxt, _ = step(cache, tok, finished, step_rng)
                 return (cache, nxt), nxt
 
-            (_, _), rest = jax.lax.scan(sbody, (cache, first), rngs[1:])
+            (_, _), rest = jax.lax.scan(sbody, (cache, first), rngs[1:],
+                                        unroll=unroll)
             toks = jnp.concatenate([first[:, None], rest.T], axis=1)
             flen = jnp.full((b,), max_new, jnp.int32)  # no stop: all real
         else:

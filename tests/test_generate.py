@@ -844,3 +844,26 @@ def test_kv_cache_dtype_validated():
         model.apply({"params": params},
                     jnp.zeros((1, 4), jnp.int32), decode=True, max_len=8,
                     mutable=["cache"])
+
+
+def test_on_mesh_int8_cache_decodes(eight_devices):
+    """on_mesh x int8 KV cache: the quantized decode cache (created inside
+    the compiled generator) composes with GSPMD tp-sharded params and
+    equals the default single-device int8 decode."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name="genmesh_i8", model="causal_lm",
+        model_kwargs={"dim": 64, "depth": 1, "heads": 4,
+                      "kv_cache_dtype": "int8", "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=128, n_test=32, batch_size=64, epochs=1, quiet=True,
+        eval_batch_size=32, tp=4,
+    )
+    t = Trainer(cfg)
+    t.fit()
+    prompt = jnp.asarray([[2, 9, 4, 7]], jnp.int32)
+    single = t.generate(prompt, max_new=6)
+    meshed = t.generate(prompt, max_new=6, on_mesh=True)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
