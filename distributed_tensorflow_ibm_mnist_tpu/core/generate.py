@@ -272,7 +272,7 @@ def make_generator(
             return live
 
         # the per-row machinery is STATIC: uniform batches (prompt_lens
-        # None) keep the scalar-cursor decode fast path — measured ~18%
+        # None) keep the scalar-cursor decode fast path — measured ~20%
         # of batched decode throughput at B=8 (models/transformer.py
         # ``ragged``, docs/PERFORMANCE.md).  Finished rows keep decoding
         # in lockstep (their cursors advance with everyone's, bounded by

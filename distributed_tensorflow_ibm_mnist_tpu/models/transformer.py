@@ -219,8 +219,9 @@ class TransformerBlock(nn.Module):
 
         ``ragged`` is STATIC: the per-row machinery (scatter-shaped cache
         writes, (B, S, half) rotation angles, (B, S, max_len) mask)
-        measures ~18% of batched decode throughput at B=8 (docs/
-        PERFORMANCE.md), so the uniform case — ``prompt_lens=None``,
+        measures ~20% of batched decode throughput at B=8 (r4: 18%
+        single-shot, r5: 22% median — docs/PERFORMANCE.md), so the
+        uniform case — ``prompt_lens=None``,
         including EOS-stopped batches, whose cursors advance in lockstep
         — keeps the scalar-cursor path (one ``dynamic_update_slice``,
         shared angles, (S, max_len) mask).  The cursor variable stays
