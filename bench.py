@@ -17,7 +17,11 @@ headline task (the MNIST CNN of SURVEY.md §2.1):
   precisely what this design removes.);
 
 plus MFU (fraction of the chip's bf16 peak, from XLA's cost analysis of the
-compiled epoch — see docs/PERFORMANCE.md for the denominator).
+compiled epoch — see docs/PERFORMANCE.md for the denominator), and a
+``dp_sharded_update`` MULTICHIP comparison block (ZeRO-1 sharded vs
+replicated weight update on a subprocess-armed dp=8 virtual mesh: step
+times + the analytic per-chip comm/compute/memory model —
+scripts/bench_sharded_update.py).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -200,6 +204,46 @@ def main() -> None:
 
             print(f"bench: LM d128 phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 4 — the MULTICHIP comparison: ZeRO-1 sharded vs replicated
+    # weight update on a dp=8 mesh (ISSUE 1).  Runs scripts/
+    # bench_sharded_update.py in a SUBPROCESS on an 8-device virtual CPU
+    # mesh so this process's accelerator backend is untouched; the block
+    # reports measured step times (parity/no-regression) plus the analytic
+    # per-chip comm/compute/memory model.  Skippable; never sinks the
+    # headline.
+    sharded = None
+    if not os.environ.get("DTM_BENCH_SKIP_SHARDED"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)  # the script arms its own device count
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_sharded_update.py")],
+                capture_output=True, text=True, timeout=420, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "dp_sharded_update":
+                    sharded = rec
+            if sharded is None:
+                print(
+                    f"bench: dp_sharded_update subprocess produced no record "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            print(f"bench: dp_sharded_update phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -260,6 +304,12 @@ def main() -> None:
             "tokens_per_sec_per_chip")
         result["lm_d128_mfu"] = lm_d128.get("mfu")
         result["lm_d128_config"] = "same LM at heads4 (head_dim 128)"
+    if sharded is not None:
+        # the dp_sharded_update comparison block (metric key dropped:
+        # nested under its own name already)
+        result["dp_sharded_update"] = {
+            k: v for k, v in sharded.items() if k != "metric"
+        }
     print(json.dumps(result), flush=True)
 
 

@@ -173,6 +173,11 @@ def make_generator(
     """
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
+    if unroll < 1:
+        raise ValueError(
+            f"unroll must be >= 1, got {unroll} (it replicates the decode-"
+            "scan body; note it applies only to the eos_id=None scan path)"
+        )
     if temperature == 0.0 and (top_k or top_p):
         raise ValueError(
             "top_k/top_p filter a SAMPLING distribution; set temperature > 0"

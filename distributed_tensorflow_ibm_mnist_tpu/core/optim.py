@@ -44,3 +44,42 @@ def make_optimizer(config: RunConfig, total_steps: int) -> optax.GradientTransfo
         # before decay/optimizer see them
         tx = optax.chain(optax.clip_by_global_norm(config.grad_clip), tx)
     return tx
+
+
+def make_sharded_update_optimizer(
+    config: RunConfig, total_steps: int
+) -> tuple[optax.GradientTransformation, float | None]:
+    """``(tx, grad_clip)`` for the ZeRO-1 sharded-update step.
+
+    The sharded step runs ``tx.update`` on this replica's 1/N bucket shards,
+    which is exact for every elementwise link in the zoo's chains (adam
+    moments, momentum traces, decayed weights, schedules) — but
+    ``optax.clip_by_global_norm`` inside ``tx`` would compute the LOCAL
+    shard norm and clip each replica differently.  So the clip link is
+    lifted out of the chain and returned as a value: the step applies it
+    against the true cross-shard norm (sum-of-squares psum) before the
+    update, reproducing :func:`make_optimizer`'s semantics exactly.
+    """
+    if not config.grad_clip:
+        return make_optimizer(config, total_steps), None
+    return (
+        make_optimizer(config.replace(grad_clip=None), total_steps),
+        float(config.grad_clip),
+    )
+
+
+def init_sharded_opt_state(tx: optax.GradientTransformation, params, layout):
+    """Optimizer state over flattened param buckets — ZeRO-1's sharded init.
+
+    One independent ``tx.init`` per bucket (so the compiled step can update
+    bucket k while bucket k+1's reduce-scatter is still on the wire without
+    sharing a single opt-state pytree across buckets); scalar leaves
+    (schedule counts) stay replicated, vector leaves are bucket-shaped and
+    get placed sharded along the dp axis by the caller.  Buckets advance in
+    lockstep, so per-bucket schedule counts agree by construction.
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.collectives import (
+        flatten_buckets,
+    )
+
+    return tuple(tx.init(b) for b in flatten_buckets(params, layout))

@@ -31,8 +31,17 @@ class TrainState:
     rng: jax.Array
 
     @classmethod
-    def create(cls, model, tx, rng: jax.Array, sample_input: jax.Array) -> "TrainState":
-        """Initialize from a model + optax transform + sample batch shape."""
+    def create(cls, model, tx, rng: jax.Array, sample_input: jax.Array,
+               opt_init=None) -> "TrainState":
+        """Initialize from a model + optax transform + sample batch shape.
+
+        ``opt_init`` overrides ``tx.init`` for the optimizer state — the
+        hook for layouts where the opt state is NOT a params-shaped tree,
+        e.g. the ZeRO-1 sharded update's per-bucket states
+        (``core.optim.init_sharded_opt_state``): the state initializes
+        already in the shape the sharded step consumes, instead of building
+        a replicated tree only to re-flatten it.
+        """
         init_rng, state_rng = jax.random.split(rng)
         variables = model.init({"params": init_rng}, sample_input, train=False)
         params = variables.get("params", {})
@@ -41,7 +50,7 @@ class TrainState:
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
-            opt_state=tx.init(params),
+            opt_state=(opt_init or tx.init)(params),
             rng=state_rng,
         )
 

@@ -117,6 +117,48 @@ def make_loss_fn(
     return loss_fn
 
 
+def _apply_sharded_update(tx, grads, params, opt_state, su, axis_name: str):
+    """The ZeRO-1 weight update inside a ``shard_map`` body.
+
+    Per bucket: mean-reduce-scatter the gradients (each replica keeps its
+    contiguous 1/N block), update ONLY that block against this bucket's
+    sharded optimizer state, all-gather the updated block back into the full
+    bucket.  Buckets are independent until the final unflatten, so XLA's
+    async collectives overlap bucket k's reduce-scatter / all-gather wire
+    time with bucket k-1's optimizer arithmetic — the latency-hiding shape
+    the bucketing exists for.  A global-norm clip (``su.clip``) is the one
+    cross-bucket coupling: it needs every bucket's scattered shard before
+    any update, and uses a psum so the norm — and therefore the trajectory —
+    matches ``optax.clip_by_global_norm`` on the replicated path exactly.
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.collectives import (
+        all_gather,
+        bucket_shard,
+        flatten_buckets,
+        grouped_reduce_scatter_mean,
+        unflatten_buckets,
+    )
+
+    lay = su.layout
+    g_shards = grouped_reduce_scatter_mean(flatten_buckets(grads, lay), axis_name)
+    if su.clip is not None:
+        # true global norm: sum of squares over every shard of every bucket
+        local_sq = sum(jnp.sum(jnp.square(g)) for g in g_shards)
+        gnorm = jnp.sqrt(jax.lax.psum(local_sq, axis_name))
+        scale = jnp.where(gnorm < su.clip, 1.0, su.clip / jnp.maximum(gnorm, 1e-38))
+        g_shards = tuple(g * scale for g in g_shards)
+    p_shards = bucket_shard(flatten_buckets(params, lay), lay, axis_name)
+    new_shards, new_opt = [], []
+    for g, opt, p in zip(g_shards, opt_state, p_shards):
+        updates, opt2 = tx.update(g, opt, p)
+        new_shards.append(optax.apply_updates(p, updates))
+        new_opt.append(opt2)
+    new_buckets = tuple(
+        all_gather(s, axis_name, axis=0, tiled=True) for s in new_shards
+    )
+    return unflatten_buckets(new_buckets, lay), tuple(new_opt)
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -125,6 +167,7 @@ def make_train_step(
     fused_xent: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    sharded_update=None,
 ):
     """Build the pure train step; ``axis_name`` enables cross-replica psum.
 
@@ -133,9 +176,23 @@ def make_train_step(
     numerically a ``grad_accum``-times-larger batch in 1/``grad_accum`` the
     activation memory (composes with ``remat`` for the full memory lever).
 
+    ``sharded_update`` (a ``parallel.collectives.ShardedUpdate``; needs
+    ``axis_name``) switches the gradient aggregation + weight update to the
+    ZeRO-1 scheme: per-bucket reduce-scatter instead of the full-tree pmean,
+    optimizer update on this replica's 1/N shard against sharded optimizer
+    state, then all-gather of the updated param buckets.  Numerically the
+    same trajectory as the replicated update (same mean gradients, same
+    elementwise optimizer math, the clip — if any — against the true global
+    norm); per-device optimizer FLOPs and mutable optimizer memory drop by
+    the axis size.  ``tx`` must then come from
+    ``optim.make_sharded_update_optimizer`` (no in-chain global-norm clip)
+    and ``state.opt_state`` from ``optim.init_sharded_opt_state``.
+
     The returned function is NOT jitted — callers jit it directly, wrap it in
     ``shard_map`` (parallel/data_parallel.py), or scan it (epoch runner).
     """
+    if sharded_update is not None and axis_name is None:
+        raise ValueError("sharded_update needs axis_name (it is a cross-replica scheme)")
     loss_fn = make_loss_fn(model, label_smoothing, fused_xent=fused_xent, remat=remat)
 
     def train_step(state: TrainState, batch: Batch):
@@ -177,15 +234,25 @@ def make_train_step(
             accuracy = acc_sum / grad_accum
             drop = None if drops is None else jnp.mean(drops)
         if axis_name is not None:
-            # The NCCL-all-reduce replacement: one fused cross-replica mean
-            # over the ICI mesh axis, inside the compiled step.
-            grads, loss, accuracy = jax.lax.pmean((grads, loss, accuracy), axis_name)
+            if sharded_update is None:
+                # The NCCL-all-reduce replacement: one fused cross-replica
+                # mean over the ICI mesh axis, inside the compiled step.
+                grads, loss, accuracy = jax.lax.pmean((grads, loss, accuracy), axis_name)
+            else:
+                # ZeRO-1: grads reduce in bucketed reduce-scatter form below;
+                # only the scalar metrics still all-reduce.
+                loss, accuracy = jax.lax.pmean((loss, accuracy), axis_name)
             if drop is not None:
                 drop = jax.lax.pmean(drop, axis_name)
             if state.batch_stats:
                 new_stats = jax.lax.pmean(new_stats, axis_name)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if sharded_update is None:
+            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+        else:
+            new_params, new_opt_state = _apply_sharded_update(
+                tx, grads, state.params, state.opt_state, sharded_update, axis_name
+            )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -211,6 +278,7 @@ def make_epoch_runner(
     fused_xent: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    sharded_update=None,
 ):
     """One full epoch as a single compiled call.
 
@@ -221,6 +289,7 @@ def make_epoch_runner(
     train_step = make_train_step(
         model, tx, axis_name=axis_name, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+        sharded_update=sharded_update,
     )
 
     def run_epoch(state: TrainState, images: jax.Array, labels: jax.Array, epoch_rng: jax.Array):
@@ -251,6 +320,7 @@ def make_chunk_runner(
     fused_xent: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    sharded_update=None,
 ):
     """Scan the train step over a leading chunk axis of stacked batches.
 
@@ -262,6 +332,7 @@ def make_chunk_runner(
     train_step = make_train_step(
         model, tx, axis_name=axis_name, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+        sharded_update=sharded_update,
     )
 
     def run_chunk(state: TrainState, batches: Batch):

@@ -226,6 +226,30 @@ class Trainer:
         self._gspmd = (
             self.tp > 1 or self.sp > 1 or self.pp > 1 or config.fsdp or self._moe_ep
         )
+        # ZeRO-1 sharded weight update (PAPERS.md: cross-replica weight-update
+        # sharding).  Two forms: the explicit bucketed shard_map step on the
+        # plain-dp paths (self._dp_sharded, a collectives.ShardedUpdate), and
+        # an opt-state spec upgrade on the fsdp GSPMD path (self._opt_specs).
+        self._dp_sharded = None
+        self._opt_specs = None
+        if config.sharded_update:
+            if self.dp <= 1:
+                raise ValueError(
+                    "sharded_update shards the weight update over the 'data' "
+                    f"axis; needs dp>1, got dp={self.dp}"
+                )
+            if config.sharded_update_buckets < 1:
+                raise ValueError(
+                    f"sharded_update_buckets must be >= 1, got "
+                    f"{config.sharded_update_buckets}"
+                )
+            if self._gspmd and not config.fsdp:
+                raise ValueError(
+                    "sharded_update composes with plain dp (bucketed "
+                    "reduce-scatter step) and with fsdp (opt-spec upgrade); "
+                    "tp/sp/pp/expert runs already shard their updates via "
+                    "GSPMD param specs"
+                )
 
         n_train = data["train_images"].shape[0]
         self.steps_per_epoch = n_train // config.batch_size
@@ -352,12 +376,44 @@ class Trainer:
         self.model = get_model(
             config.model, num_classes=self.num_classes, **model_kwargs
         )
-        self.tx = make_optimizer(config, total_steps)
+        if config.sharded_update and not self._gspmd:
+            # the clip link is lifted OUT of the chain: the sharded step
+            # applies it against the true cross-shard norm (optim.py)
+            from distributed_tensorflow_ibm_mnist_tpu.core.optim import (
+                make_sharded_update_optimizer,
+            )
+
+            self.tx, sharded_clip = make_sharded_update_optimizer(config, total_steps)
+        else:
+            self.tx = make_optimizer(config, total_steps)
 
         root = jax.random.PRNGKey(config.seed)
         state_rng, self._data_rng = jax.random.split(root)
         sample = jnp.zeros((1,) + data["train_images"].shape[1:], jnp.uint8)
-        state = TrainState.create(self.model, self.tx, state_rng, sample)
+        if config.sharded_update and not self._gspmd:
+            from distributed_tensorflow_ibm_mnist_tpu.core.optim import (
+                init_sharded_opt_state,
+            )
+            from distributed_tensorflow_ibm_mnist_tpu.parallel.collectives import (
+                ShardedUpdate,
+                make_bucket_layout,
+            )
+
+            def _sharded_opt_init(params):
+                # layout derives from the real param tree, so build it here
+                # (inside create) and let the state initialize directly in
+                # bucket form — no replicated tree is ever materialized
+                layout = make_bucket_layout(
+                    params, self.dp, n_buckets=config.sharded_update_buckets
+                )
+                self._dp_sharded = ShardedUpdate(layout=layout, clip=sharded_clip)
+                return init_sharded_opt_state(self.tx, params, layout)
+
+            state = TrainState.create(
+                self.model, self.tx, state_rng, sample, opt_init=_sharded_opt_init
+            )
+        else:
+            state = TrainState.create(self.model, self.tx, state_rng, sample)
 
         if config.input_mode not in ("device", "stream"):
             raise ValueError(f"input_mode must be 'device' or 'stream', got {config.input_mode!r}")
@@ -393,10 +449,12 @@ class Trainer:
 
                 img_ndim = self.train_images.ndim
                 self._train_step = make_dp_train_step(
-                    self.model, self.tx, self.mesh, img_ndim=img_ndim, **step_kw
+                    self.model, self.tx, self.mesh, img_ndim=img_ndim,
+                    sharded_update=self._dp_sharded, state=state, **step_kw
                 )
                 self._train_chunk = make_dp_chunk_runner(
-                    self.model, self.tx, self.mesh, img_ndim=img_ndim, **step_kw
+                    self.model, self.tx, self.mesh, img_ndim=img_ndim,
+                    sharded_update=self._dp_sharded, state=state, **step_kw
                 )
             else:
                 from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
@@ -431,6 +489,16 @@ class Trainer:
                     state.params, self.mesh,
                     base_rule=megatron_rule(self.tp) if self.tp > 1 else None,
                 )
+                if config.sharded_update:
+                    # ZeRO-1 residue on ZeRO-3: moments of min_size-replicated
+                    # params shard over 'data' too (fsdp.make_fsdp_opt_specs)
+                    from distributed_tensorflow_ibm_mnist_tpu.parallel.fsdp import (
+                        make_fsdp_opt_specs,
+                    )
+
+                    self._opt_specs = make_fsdp_opt_specs(
+                        state, self.mesh, self._tp_specs
+                    )
             else:
                 # structural rules (stacked pipe stages, expert dims) first:
                 # the Megatron name rules must not see those leaves
@@ -451,7 +519,8 @@ class Trainer:
                 self._tp_specs = make_param_specs(state.params, chain_rules(*rules))
             self._run_epoch = make_tp_epoch_runner(
                 self.model, self.tx, self.mesh, self._tp_specs, state,
-                config.batch_size, img_ndim=data["train_images"].ndim, **step_kw,
+                config.batch_size, img_ndim=data["train_images"].ndim,
+                opt_specs=self._opt_specs, **step_kw,
             )
             self.train_images, self.train_labels = shard_dataset(
                 self.mesh, data["train_images"], data["train_labels"]
@@ -462,7 +531,8 @@ class Trainer:
             )
             self._run_epoch = make_dp_epoch_runner(
                 self.model, self.tx, config.batch_size, self.mesh,
-                img_ndim=self.train_images.ndim, **step_kw,
+                img_ndim=self.train_images.ndim,
+                sharded_update=self._dp_sharded, state=state, **step_kw,
             )
         else:
             self.train_images = jax.device_put(data["train_images"])
@@ -719,15 +789,41 @@ class Trainer:
                 shard_train_state,
             )
 
-            return shard_train_state(self.mesh, state, self._tp_specs)
+            return shard_train_state(
+                self.mesh, state, self._tp_specs, opt_specs=self._opt_specs
+            )
         if self.dp > 1:
+            if self._dp_sharded is not None:
+                from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+                    place_sharded_update_state,
+                )
+
+                return place_sharded_update_state(
+                    self.mesh, state, self._dp_sharded.layout
+                )
             return replicate(self.mesh, state)
         return jax.device_put(state)
 
     def save_checkpoint(self, wait: bool = True) -> int | None:
         if self._ckpt is None:
             return None
-        return self._ckpt.save(self.state, wait=wait)
+        state = self.state
+        if self._dp_sharded is not None:
+            # gather-on-save for the ZeRO-1 buckets: the on-disk opt arrays
+            # are whole (one contiguous bucket each) instead of dp scattered
+            # shard files — inspectable offline, and restore still lands
+            # directly in the sharded layout (the restore target's shardings
+            # steer orbax, see restore_checkpoint).  Bucket padding is a
+            # function of dp, so cross-dp resume remains config-bound either
+            # way; params/stats stay as placed (already replicated).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            state = state.replace(opt_state=jax.tree.map(
+                lambda x: jax.device_put(x, rep) if isinstance(x, jax.Array) else x,
+                state.opt_state,
+            ))
+        return self._ckpt.save(state, wait=wait)
 
     def restore_checkpoint(self, step: int | None = None) -> int:
         """Resume from the checkpoint dir; returns the restored step."""
@@ -859,6 +955,11 @@ class Trainer:
         cached = getattr(self, "_opt_flops_cache", None)
         if cached is not None:
             return cached[0]
+        if self._dp_sharded is not None:
+            # bucketed opt state is not a params-shaped tree; skipping the
+            # correction keeps the documented slight overcount for the
+            # (sharded_update x grad_accum>1) corner instead of crashing
+            return None
         import optax
 
         from distributed_tensorflow_ibm_mnist_tpu.utils.flops import compiled_flops
@@ -1063,6 +1164,10 @@ class Trainer:
         compiled cache size across varying prompt lengths.  ``eos_id`` /
         ``pad_id`` / ``prompt_lens`` per :func:`~..core.generate.
         make_generator` (stop tokens, ragged right-padded prompts).
+        ``make_generator``'s ``unroll`` knob is deliberately NOT plumbed
+        through this API (or its cache key): it was measured a rejection
+        on the v5e (see the in-body note there) — call ``make_generator``
+        directly to exercise it on other hardware.
         ``with_lengths=True`` changes the return to ``(tokens,
         gen_lens)`` — ``gen_lens`` (B,) int32 is each row's REAL
         generated token count (EOS included; ``max_new`` for rows that

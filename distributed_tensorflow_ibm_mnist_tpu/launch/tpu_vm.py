@@ -29,6 +29,16 @@ def bootstrap(
     """
     multi = num_processes is not None and num_processes > 1
     if multi or coordinator_address is not None:
+        # CPU clusters: the default (no-op) CPU collectives layer cannot run
+        # cross-process computations ("Multiprocess computations aren't
+        # implemented on the CPU backend") — arm the gloo TCP collectives
+        # BEFORE the backend client exists.  TPU/GPU ignore this flag, and
+        # jax versions without it (or builds without gloo) skip it silently
+        # rather than fail the bootstrap.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

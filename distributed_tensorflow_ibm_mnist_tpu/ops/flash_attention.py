@@ -112,6 +112,15 @@ _GROUPED_BWD = True
 # chip accepts with headroom.
 _GROUPED_DQ_VMEM_BUDGET = int(2.5 * 1024 * 1024)
 
+# Group-count ceiling for the grouped backward.  The group sizing walks
+# n_qg down to a divisor of n_q; a tile count with no divisor under the
+# VMEM budget (e.g. prime n_q) would collapse n_qg to 1 and emit n_q
+# full-length f32 partial dK/dV buffers — 2 x (bh, n_q, sp, d) transient
+# HBM that can dwarf the model at long S (ADVICE.md r5).  Past this many
+# groups the partial-buffer cost outweighs the one-recompute win, so the
+# kernel falls back to the two-kernel scheme instead.
+_GROUPED_MAX_GROUPS = 8
+
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
@@ -749,7 +758,7 @@ def _bwd_calls(q, k, v, g, lse, delta, causal, interpret, window=0):
     n_qg = min(n_q, max(1, budget_rows // block_q))
     while n_q % n_qg:
         n_qg -= 1
-    if _GROUPED_BWD and n_q // n_qg >= 2:
+    if _GROUPED_BWD and 2 <= n_q // n_qg <= _GROUPED_MAX_GROUPS:
         n_groups = n_q // n_qg
         group_rows = n_qg * block_q
         g_fold = h // hkv

@@ -14,6 +14,8 @@ Mapping (reference -> here):
 * PS variable broadcast (read)      -> :func:`broadcast` (one-to-all)
 * PS sharded variable gather        -> :func:`all_gather`
 * NCCL reduce-scatter (ZeRO-style)  -> :func:`reduce_scatter`
+* bucketed grad reduce-scatter      -> :func:`make_bucket_layout` /
+  (ZeRO-1 sharded weight update)       :func:`grouped_reduce_scatter_mean`
 * ring neighbor exchange            -> :func:`ring_shift` / ``ppermute``
   (the primitive under ring-attention sequence parallelism)
 * MoE token dispatch                -> :func:`all_to_all`
@@ -29,6 +31,7 @@ to :func:`all_reduce_mean`.)
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, TypeVar
 
 import jax
@@ -39,8 +42,14 @@ T = TypeVar("T")
 
 
 def axis_size(axis_name: str) -> int:
-    """Number of shards along ``axis_name`` (static under tracing)."""
-    return lax.axis_size(axis_name)
+    """Number of shards along ``axis_name`` (static under tracing).
+
+    ``lax.axis_size`` only exists on newer jax; ``psum(1, axis)`` constant-
+    folds to a Python int on every version this repo supports.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def axis_index(axis_name: str) -> jax.Array:
@@ -99,7 +108,7 @@ def ring_shift(x: T, axis_name: str, shift: int = 1) -> T:
     neighbor exchange that ring attention and pipeline transfers ride; XLA
     lowers it to nearest-neighbor ICI hops on a TPU torus.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.tree.map(lambda v: lax.ppermute(v, axis_name, perm), x)
 
@@ -123,3 +132,160 @@ def grad_norm_global(grads: Any, axis_name: str | None = None) -> jax.Array:
     if axis_name is None:
         return local
     return jnp.sqrt(lax.psum(jnp.square(local), axis_name))
+
+
+# ---------------------------------------------------------------------------
+# Gradient bucketing for the ZeRO-1 sharded weight update (PAPERS.md: the
+# "Automatic Cross-Replica Sharding of Weight Update" recipe).  A pytree of
+# gradients flattens into a FEW contiguous 1-D buckets so the reduce-scatter
+# pays per-collective latency a handful of times, not once per bias vector;
+# each bucket is padded to a multiple of the shard count so every device owns
+# an equal contiguous block.  The layout is static (built once from the param
+# tree, closed over by the compiled step) — flatten/unflatten are pure
+# reshape/concat/slice, fused by XLA around the collectives.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _LeafSlot:
+    """Where one leaf lives: ``bucket[offset : offset + size]``."""
+
+    bucket: int
+    offset: int
+    size: int
+    shape: tuple[int, ...]
+    dtype: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Static flatten plan: leaf slots + padded per-bucket sizes.
+
+    ``bucket_sizes`` are multiples of ``n_shards``; ``shard_sizes`` is the
+    per-device block each reduce-scatter leaves behind.  Buckets are
+    single-dtype (mixed-precision trees get one bucket group per dtype) and
+    size-balanced greedily — whole leaves are never split across buckets.
+    """
+
+    treedef: Any
+    slots: tuple[_LeafSlot, ...]
+    bucket_sizes: tuple[int, ...]
+    n_shards: int
+
+    @property
+    def shard_sizes(self) -> tuple[int, ...]:
+        return tuple(s // self.n_shards for s in self.bucket_sizes)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.bucket_sizes)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedUpdate:
+    """Everything the compiled step needs for a ZeRO-1 sharded weight update.
+
+    ``layout``: the :class:`BucketLayout` over the param/grad tree.
+    ``clip``: the run's global-norm clip value, applied by the step against
+    the TRUE cross-shard norm (``optax.clip_by_global_norm`` inside the
+    optimizer chain would see only this replica's shard — see
+    ``core.optim.make_sharded_update_optimizer``).
+    """
+
+    layout: BucketLayout
+    clip: float | None = None
+
+
+def make_bucket_layout(tree: Any, n_shards: int, n_buckets: int = 4) -> BucketLayout:
+    """Plan a size-balanced bucketing of ``tree``'s leaves.
+
+    Greedy balance: leaves (grouped by dtype, largest first) land in the
+    currently-lightest bucket of their dtype group, so a tree with one
+    dominant kernel and many small biases still produces buckets of
+    comparable size rather than one giant and three empties.  Each bucket is
+    zero-padded up to a multiple of ``n_shards``.
+    """
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if n_buckets < 1:
+        raise ValueError(f"n_buckets must be >= 1, got {n_buckets}")
+    leaves, treedef = jax.tree.flatten(tree)
+    by_dtype: dict[Any, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    slots: dict[int, _LeafSlot] = {}
+    bucket_sizes: list[int] = []
+    for dtype in sorted(by_dtype, key=str):
+        idxs = by_dtype[dtype]
+        k = min(n_buckets, len(idxs))
+        base = len(bucket_sizes)
+        fill = [0] * k
+        # largest-first greedy into the lightest bucket (stable tie-break on
+        # original leaf order keeps the layout deterministic)
+        for i in sorted(idxs, key=lambda i: (-int(leaves[i].size), i)):
+            b = min(range(k), key=lambda j: fill[j])
+            slots[i] = _LeafSlot(
+                bucket=base + b, offset=fill[b], size=int(leaves[i].size),
+                shape=tuple(leaves[i].shape), dtype=dtype,
+            )
+            fill[b] += int(leaves[i].size)
+        bucket_sizes += [-(-f // n_shards) * n_shards for f in fill]
+    return BucketLayout(
+        treedef=treedef,
+        slots=tuple(slots[i] for i in range(len(leaves))),
+        bucket_sizes=tuple(bucket_sizes),
+        n_shards=n_shards,
+    )
+
+
+def flatten_buckets(tree: Any, layout: BucketLayout) -> tuple[jax.Array, ...]:
+    """Pytree -> padded 1-D buckets per ``layout`` (pure reshape/concat)."""
+    leaves = jax.tree.leaves(tree)
+    pieces: list[list[tuple[int, jax.Array]]] = [[] for _ in layout.bucket_sizes]
+    for slot, leaf in zip(layout.slots, leaves):
+        pieces[slot.bucket].append((slot.offset, jnp.ravel(leaf).astype(slot.dtype)))
+    out = []
+    for b, sized in enumerate(layout.bucket_sizes):
+        parts = [p for _, p in sorted(pieces[b], key=lambda t: t[0])]
+        used = sum(int(p.size) for p in parts)
+        if used < sized:
+            dtype = parts[0].dtype if parts else jnp.float32
+            parts.append(jnp.zeros((sized - used,), dtype))
+        out.append(jnp.concatenate(parts) if len(parts) > 1 else parts[0])
+    return tuple(out)
+
+
+def unflatten_buckets(buckets: tuple[jax.Array, ...], layout: BucketLayout) -> Any:
+    """Inverse of :func:`flatten_buckets` (padding discarded)."""
+    leaves = [
+        buckets[s.bucket][s.offset : s.offset + s.size].reshape(s.shape)
+        for s in layout.slots
+    ]
+    return jax.tree.unflatten(layout.treedef, leaves)
+
+
+def grouped_reduce_scatter_mean(
+    buckets: tuple[jax.Array, ...], axis_name: str
+) -> tuple[jax.Array, ...]:
+    """Mean-reduce-scatter every bucket: ``(B,)`` -> this shard's ``(B/N,)``.
+
+    All scatters are issued before any dependent compute so XLA's async
+    collectives can overlap bucket k's wire time with bucket k-1's optimizer
+    update (the overlap the bucketing exists to expose)."""
+    n = axis_size(axis_name)
+    return tuple(
+        lax.psum_scatter(b, axis_name, scatter_dimension=0, tiled=True) / n
+        for b in buckets
+    )
+
+
+def bucket_shard(
+    buckets: tuple[jax.Array, ...], layout: BucketLayout, axis_name: str
+) -> tuple[jax.Array, ...]:
+    """This device's contiguous block of each full bucket (no comm)."""
+    idx = lax.axis_index(axis_name)
+    return tuple(
+        lax.dynamic_slice(b, (idx * sz,), (sz,))
+        for b, sz in zip(buckets, layout.shard_sizes)
+    )

@@ -97,10 +97,58 @@ def replicate(mesh: Mesh, tree):
     return jax.device_put(tree, sharding)
 
 
+def sharded_update_state_specs(state, layout, axis: str = AXIS):
+    """TrainState-shaped PartitionSpec tree for the ZeRO-1 layout.
+
+    Optimizer-state bucket vectors (the ``init_sharded_opt_state`` leaves —
+    1-D, exactly a padded bucket size) are sharded over ``axis``; schedule
+    counts and everything else (params, BatchNorm stats, step, rng) stay
+    replicated — ZeRO-1's defining split.  Only the ``opt_state`` subtree is
+    shape-matched, so a param leaf that happens to share a bucket's length
+    can never be mis-sharded.
+    """
+    sizes = set(layout.bucket_sizes)
+
+    def opt_spec(leaf):
+        return P(axis) if getattr(leaf, "ndim", 0) == 1 and leaf.shape[0] in sizes else P()
+
+    def rep(tree):
+        return jax.tree.map(lambda _: P(), tree)
+
+    return state.replace(
+        step=P(), params=rep(state.params), batch_stats=rep(state.batch_stats),
+        opt_state=jax.tree.map(opt_spec, state.opt_state), rng=P(),
+    )
+
+
+def place_sharded_update_state(mesh: Mesh, state, layout, axis: str = AXIS):
+    """Place a ZeRO-1 TrainState: opt buckets sharded over ``axis``, rest
+    replicated — the sharded-update counterpart of :func:`replicate`."""
+    specs = sharded_update_state_specs(state, layout, axis)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.device_put(state, shardings)
+
+
+def _state_specs(sharded_update, state, axis: str):
+    """shard_map spec for the TrainState slot: ``P()`` (fully replicated) on
+    the classic path; the ZeRO-1 mixed tree when ``sharded_update`` is set
+    (which needs ``state`` — already in sharded-opt layout — as template)."""
+    if sharded_update is None:
+        return P()
+    if state is None:
+        raise ValueError(
+            "sharded_update needs a template state (opt_state already in "
+            "init_sharded_opt_state's bucket layout) to derive the spec tree"
+        )
+    return sharded_update_state_specs(state, sharded_update.layout, axis)
+
+
 def make_dp_train_step(
     model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0,
     fused_xent: bool = False, remat: bool = False, grad_accum: int = 1,
-    img_ndim: int = 4,
+    img_ndim: int = 4, sharded_update=None, state=None,
 ):
     """Single DP step over a batch sharded along the data axis.
 
@@ -108,17 +156,25 @@ def make_dp_train_step(
     batch: per-shard mean loss + gradient ``pmean`` == full-batch mean
     gradient.  Used for per-step control flow (checkpoint-every-N, custom
     loops); the epoch runner below is the fast path.
+
+    ``sharded_update`` (a ``collectives.ShardedUpdate``) switches to the
+    ZeRO-1 step — bucketed reduce-scatter, 1/N optimizer update against
+    dp-sharded optimizer state, all-gather of updated params; pass the
+    sharded-layout ``state`` as spec template and place states with
+    :func:`place_sharded_update_state` instead of :func:`replicate`.
     """
     train_step = make_train_step(
         model, tx, axis_name=axis, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+        sharded_update=sharded_update,
     )
     img_spec = P(axis, *([None] * (img_ndim - 1)))
+    st_spec = _state_specs(sharded_update, state, axis)
     wrapped = shard_map_compat(
         train_step,
         mesh,
-        in_specs=(P(), {"image": img_spec, "label": P(axis)}),
-        out_specs=(P(), P()),
+        in_specs=(st_spec, {"image": img_spec, "label": P(axis)}),
+        out_specs=(st_spec, P()),
     )
     return jax.jit(wrapped, donate_argnums=(0,))
 
@@ -126,24 +182,27 @@ def make_dp_train_step(
 def make_dp_chunk_runner(
     model, tx, mesh: Mesh, axis: str = AXIS, label_smoothing: float = 0.0,
     fused_xent: bool = False, remat: bool = False, grad_accum: int = 1,
-    img_ndim: int = 4,
+    img_ndim: int = 4, sharded_update=None, state=None,
 ):
     """DP companion of steps.make_chunk_runner: scan k stacked global batches
     (leaves ``(k, global_batch, ...)``, batch dim sharded over ``axis``) in one
     compiled shard_map call — stream mode's one-transfer-per-k-steps path.
 
     ``img_ndim``: rank of ONE image batch (4 for NHWC); callers with other
-    input ranks pass their own so the spec's trailing dims match."""
+    input ranks pass their own so the spec's trailing dims match.
+    ``sharded_update``/``state`` as in :func:`make_dp_train_step`."""
     run_chunk = make_chunk_runner(
         model, tx, axis_name=axis, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+        sharded_update=sharded_update,
     )
     img_spec = P(None, axis, *([None] * (img_ndim - 1)))
+    st_spec = _state_specs(sharded_update, state, axis)
     wrapped = shard_map_compat(
         run_chunk,
         mesh,
-        in_specs=(P(), {"image": img_spec, "label": P(None, axis)}),
-        out_specs=(P(), P()),
+        in_specs=(st_spec, {"image": img_spec, "label": P(None, axis)}),
+        out_specs=(st_spec, P()),
     )
     return jax.jit(wrapped, donate_argnums=(0,))
 
@@ -159,6 +218,8 @@ def make_dp_epoch_runner(
     remat: bool = False,
     grad_accum: int = 1,
     img_ndim: int = 4,
+    sharded_update=None,
+    state=None,
 ):
     """Epoch runner over a sharded dataset: one jitted shard_map per epoch.
 
@@ -167,6 +228,11 @@ def make_dp_epoch_runner(
     replicated.  Each device samples from its local shard only (no
     cross-device gathers in the hot loop); gradient pmean is the only
     collective per step.
+
+    With ``sharded_update`` set (see :func:`make_dp_train_step`) the per-step
+    collectives become the ZeRO-1 set — bucketed reduce-scatter + updated-
+    param all-gather — and the optimizer state rides the scan sharded over
+    ``axis``.
     """
     dp = mesh.shape[axis]
     if global_batch % dp:
@@ -178,13 +244,15 @@ def make_dp_epoch_runner(
     local_epoch = make_epoch_runner(
         model, tx, local_batch, axis_name=axis, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
+        sharded_update=sharded_update,
     )
 
     img_spec = P(axis, *([None] * (img_ndim - 1)))
+    st_spec = _state_specs(sharded_update, state, axis)
     wrapped = shard_map_compat(
         local_epoch,
         mesh,
-        in_specs=(P(), img_spec, P(axis), P()),
-        out_specs=(P(), P()),
+        in_specs=(st_spec, img_spec, P(axis), P()),
+        out_specs=(st_spec, P()),
     )
     return jax.jit(wrapped, donate_argnums=(0,))

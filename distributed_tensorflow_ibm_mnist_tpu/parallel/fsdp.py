@@ -23,6 +23,7 @@ with the ZeRO bound holding at ``4x P / (tp * dp)`` rather than ``4x P / tp``.
 
 from __future__ import annotations
 
+import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
@@ -92,6 +93,41 @@ def make_fsdp_specs(
     )
 
 
+def make_fsdp_opt_specs(
+    state: TrainState,
+    mesh: Mesh,
+    param_specs,
+    axis: str = "data",
+):
+    """ZeRO-1 spec tree for ``state.opt_state``: moments sharded EVERYWHERE.
+
+    By default optimizer leaves inherit their param's layout by suffix match
+    (``specs_like``) — so a param kept replicated by ``fsdp_rule``'s
+    ``min_size`` gather-cost threshold keeps REPLICATED adam moments too.
+    That threshold is about the forward's all-gather; it does not apply to
+    optimizer state, which is only ever consumed in place by the update.
+    This builder upgrades every still-replicated opt leaf with a divisible
+    dim to ``P(axis, ...)`` — XLA then reduce-scatters those gradients,
+    updates the local block, and all-gathers the params, cutting mutable
+    optimizer memory to the full ZeRO bound even for the small-leaf tail.
+    Sharded-or-inherited specs (the big kernels' moments) are kept verbatim.
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+        specs_like,
+    )
+
+    base = specs_like(state.opt_state, state.params, param_specs)
+    rule = fsdp_rule(mesh.shape[axis], axis=axis, min_size=1)
+
+    def upgrade(leaf, spec):
+        return spec if spec != P() else rule((), leaf)
+
+    return jax.tree.map(
+        upgrade, state.opt_state, base,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def make_fsdp_train_step(
     model,
     tx,
@@ -101,6 +137,7 @@ def make_fsdp_train_step(
     data_axis: str = "data",
     label_smoothing: float = 0.0,
     fused_xent: bool = False,
+    opt_specs=None,
 ):
     """Jit the plain train step under FSDP shardings (ZeRO-3 over ICI).
 
@@ -109,16 +146,22 @@ def make_fsdp_train_step(
     sharded over the same ``data`` axis, so gradient reduction arrives as
     reduce-scatter (each device reduces only the shard it owns) rather than
     the replicated DP all-reduce.
+
+    ``opt_specs`` (see :func:`make_fsdp_opt_specs`) overrides the optimizer
+    state's suffix-matched layout — the sharded-update mode that keeps even
+    the small-leaf moments at 1/N per device.
     """
     return make_tp_train_step(
         model, tx, mesh, param_specs, state,
         data_axis=data_axis, label_smoothing=label_smoothing, fused_xent=fused_xent,
+        opt_specs=opt_specs,
     )
 
 
 __all__ = [
     "fsdp_rule",
     "make_fsdp_specs",
+    "make_fsdp_opt_specs",
     "make_fsdp_train_step",
     "shard_train_state",
 ]

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributed_tensorflow_ibm_mnist_tpu.parallel.collectives import axis_size
 from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import shard_map_compat
 
 
@@ -84,7 +85,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     dtype = q.dtype
     k, v = _expand_kv_groups(q, k, v)
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = d**-0.5
@@ -154,7 +155,7 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, interpret):
     """n flash-block calls + n-1 ppermute hops -> (out, global lse)."""
     from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_block_fwd
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     m_run = jnp.full((b, s_local, h), _NEG, jnp.float32)
@@ -203,7 +204,7 @@ def _ring_flash_bwd(axis_name, causal, interpret, res, g):
     from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import flash_block_bwd
 
     q, k, v, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)

@@ -190,20 +190,31 @@ def specs_like(target, params, param_specs, default: P = P()):
     return jax.tree_util.tree_map_with_path(leaf_spec, target)
 
 
-def state_shardings(mesh: Mesh, state: TrainState, param_specs) -> TrainState:
-    """NamedSharding tree for a full TrainState from its param spec tree."""
+def state_shardings(mesh: Mesh, state: TrainState, param_specs,
+                    opt_specs=None) -> TrainState:
+    """NamedSharding tree for a full TrainState from its param spec tree.
+
+    ``opt_specs``: explicit spec tree for the ``opt_state`` subtree,
+    overriding the suffix-matched defaults — the ZeRO-1 sharded-update hook
+    (``fsdp.make_fsdp_opt_specs``) that shards optimizer moments beyond
+    their params' own layout."""
     spec_tree = specs_like(state, state.params, param_specs)
     # params subtree: take the annotated specs verbatim (not suffix-matched)
     spec_tree = spec_tree.replace(params=param_specs)
+    if opt_specs is not None:
+        spec_tree = spec_tree.replace(opt_state=opt_specs)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), spec_tree,
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
-def shard_train_state(mesh: Mesh, state: TrainState, param_specs) -> TrainState:
+def shard_train_state(mesh: Mesh, state: TrainState, param_specs,
+                      opt_specs=None) -> TrainState:
     """Place a host/replicated TrainState onto the mesh with TP shardings."""
-    return jax.device_put(state, state_shardings(mesh, state, param_specs))
+    return jax.device_put(
+        state, state_shardings(mesh, state, param_specs, opt_specs=opt_specs)
+    )
 
 
 def make_tp_train_step(
@@ -217,6 +228,7 @@ def make_tp_train_step(
     fused_xent: bool = False,
     remat: bool = False,
     grad_accum: int = 1,
+    opt_specs=None,
 ):
     """Jit the plain train step under combined DP x TP GSPMD shardings.
 
@@ -225,13 +237,15 @@ def make_tp_train_step(
     ``data_axis``.  No collective appears in the step body: the SPMD
     partitioner derives the gradient all-reduce over ``data`` and the
     activation gathers over ``model`` from the sharding constraints alone.
+    ``opt_specs`` overrides the optimizer state's suffix-matched layout
+    (the fsdp sharded-update mode).
     """
     train_step = make_train_step(
         model, tx, axis_name=None, label_smoothing=label_smoothing,
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
     st_shard, img_shard, lab_shard, metric_shard = _tp_shardings(
-        mesh, state, param_specs, data_axis
+        mesh, state, param_specs, data_axis, opt_specs=opt_specs
     )
     return jax.jit(
         train_step,
@@ -242,12 +256,12 @@ def make_tp_train_step(
 
 
 def _tp_shardings(mesh: Mesh, state: TrainState, param_specs, data_axis: str,
-                  img_ndim: int = 4):
+                  img_ndim: int = 4, opt_specs=None):
     """(state, image, label, metric) NamedShardings for the DP x TP layout.
 
     ``img_ndim``: rank of the input batch (4 for NHWC images, 2 for token
     sequences) so the spec's trailing dims match the data."""
-    st_shard = state_shardings(mesh, state, param_specs)
+    st_shard = state_shardings(mesh, state, param_specs, opt_specs=opt_specs)
     img_shard = NamedSharding(mesh, P(data_axis, *([None] * (img_ndim - 1))))
     lab_shard = NamedSharding(mesh, P(data_axis))
     metric_shard = NamedSharding(mesh, P())
@@ -267,6 +281,7 @@ def make_tp_epoch_runner(
     remat: bool = False,
     grad_accum: int = 1,
     img_ndim: int = 4,
+    opt_specs=None,
 ):
     """Whole-epoch scan under DP x TP GSPMD shardings — the Trainer's TP path.
 
@@ -283,7 +298,7 @@ def make_tp_epoch_runner(
         fused_xent=fused_xent, remat=remat, grad_accum=grad_accum,
     )
     st_shard, img_shard, lab_shard, metric_shard = _tp_shardings(
-        mesh, state, param_specs, data_axis, img_ndim=img_ndim
+        mesh, state, param_specs, data_axis, img_ndim=img_ndim, opt_specs=opt_specs
     )
     return jax.jit(
         run_epoch,

@@ -82,6 +82,21 @@ class RunConfig:
     #                           of ticks are idle per stage.
     fsdp: bool = False  # ZeRO-3: shard params + opt state over 'data' (needs
     #                     dp>1; composes with tp into the 2D TP-within layout)
+    sharded_update: bool = False  # ZeRO-1 sharded weight update (needs dp>1).
+    #   Plain-dp runs: gradients flatten into a few size-balanced contiguous
+    #   buckets, each bucket reduce-scatters instead of all-reducing, the
+    #   optimizer updates only this replica's 1/N block against dp-SHARDED
+    #   optimizer state, and the updated param buckets all-gather — per-chip
+    #   optimizer FLOPs and mutable optimizer memory drop by dp while the
+    #   loss trajectory stays that of the replicated update (PAPERS.md:
+    #   "Automatic Cross-Replica Sharding of Weight Update").  fsdp runs:
+    #   upgrades the optimizer-state specs so even the moments of
+    #   min_size-replicated params shard over 'data'.  Off by default until
+    #   parity is proven on the target topology (tests pin it on the
+    #   virtual mesh).
+    sharded_update_buckets: int = 4  # gradient buckets for sharded_update's
+    #   flatten (more buckets = finer comm/compute overlap, more collective
+    #   launches; 4 is a good default for small-to-mid models)
     dcn_dp: int = 1  # multislice: how many TPU slices the data axis spans
     #   (dcn_dp must divide dp; only the gradient all-reduce crosses DCN,
     #   model/seq/pipe collectives stay on each slice's ICI — see

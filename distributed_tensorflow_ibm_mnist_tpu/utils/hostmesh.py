@@ -43,6 +43,41 @@ def ensure_virtual_cpu_devices(n: int) -> int:
         import jax.extend as jex
 
         jex.backend.clear_backends()
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        jax.config.update("jax_platforms", "cpu")
+        return len(jax.devices())
+    except AttributeError:
+        pass
+    # pre-0.5 jax has no jax_num_cpu_devices, and the C++ layer parses
+    # XLA_FLAGS exactly once per process — once a too-small backend was
+    # built, no in-process rebuild can widen it.  Arm the env and re-exec
+    # the script (marker env guards against a loop); if re-exec is not
+    # possible (interactive session, argv gone) fall through and report
+    # the count we actually have so callers can degrade explicitly.
+    import os
+    import sys
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if (
+        os.environ.get("_DTM_HOSTMESH_REEXEC") != "1"
+        and getattr(sys, "argv", None)
+        and sys.argv[0]
+        and os.path.exists(sys.argv[0])
+    ):
+        os.environ["_DTM_HOSTMESH_REEXEC"] = "1"
+        # under `python -m pkg.mod`, argv[0] is the module FILE and the
+        # re-exec runs it in script mode, which would drop the package
+        # root off sys.path — carry the live path so imports resolve
+        # identically in the re-exec'd process
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(p or os.getcwd() for p in sys.path)
+        )
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     jax.config.update("jax_platforms", "cpu")
     return len(jax.devices())

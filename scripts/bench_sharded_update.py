@@ -1,0 +1,167 @@
+"""Sharded vs replicated weight update: step time + modeled comm volume.
+
+The `dp_sharded_update` comparison block for bench.py's MULTICHIP section:
+runs the SAME dp=8 train step twice — replicated update (pmean + full
+`tx.update` on every chip) and ZeRO-1 sharded update (bucketed
+reduce-scatter + 1/N update + all-gather) — on the virtual CPU mesh, and
+reports measured steady-state step times beside the analytic per-chip
+comm/compute/memory model.  Designed to run in a SUBPROCESS (bench.py
+spawns it with `JAX_PLATFORMS=cpu` + an 8-device XLA flag env) so the
+parent's TPU backend is untouched; it also self-arms when run directly:
+
+    python scripts/bench_sharded_update.py [n_devices] [adam|momentum]
+
+Prints ONE JSON line.  Honest caveat baked into the output: virtual CPU
+devices time-share one host, so `step_time_ms` shows parity/no-regression,
+not ICI wire time — `modeled_comm_bytes_per_chip` carries the comm math
+(ring collectives: all-reduce moves 2(N-1)/N·P elements per chip; the
+sharded scheme's reduce-scatter + param all-gather moves the same wire
+bytes but cuts the optimizer's update FLOPs and mutable state by N).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+OPTIMIZER = sys.argv[2] if len(sys.argv) > 2 else "adam"
+
+# arm the virtual mesh BEFORE jax initializes (subprocess-friendly)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={N_DEVICES}"
+    ).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+
+def main() -> None:
+    from distributed_tensorflow_ibm_mnist_tpu.core.optim import (
+        init_sharded_opt_state,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.collectives import (
+        ShardedUpdate,
+        make_bucket_layout,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+        make_dp_train_step,
+        place_sharded_update_state,
+        replicate,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.mesh import make_mesh
+
+    n = N_DEVICES
+    assert len(jax.devices()) >= n, (
+        f"need {n} devices, have {len(jax.devices())} — run via bench.py or "
+        "with JAX_PLATFORMS=cpu and the XLA device-count flag unset elsewhere"
+    )
+    mesh = make_mesh(dp=n)
+    # a hidden stack big enough that the update/comm terms are visible
+    # beside the matmuls, small enough for the 1-core virtual mesh
+    model = get_model("mlp", num_classes=10, hidden=(512, 512), dtype=jnp.float32)
+    tx = optax.adam(1e-3) if OPTIMIZER == "adam" else optax.sgd(1e-2, momentum=0.9)
+    state = TrainState.create(
+        model, tx, jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1), jnp.uint8)
+    )
+    p_count = state.param_count()
+    layout = make_bucket_layout(state.params, n_shards=n, n_buckets=4)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(
+            rng.integers(0, 255, size=(32 * n, 28, 28, 1), dtype=np.uint8)),
+        "label": jnp.asarray(rng.integers(0, 10, size=(32 * n,)).astype(np.int32)),
+    }
+
+    sh_state = state.replace(
+        opt_state=init_sharded_opt_state(tx, state.params, layout))
+    sh_state = place_sharded_update_state(mesh, sh_state, layout)
+    # fresh buffers for the replicated leg: device_put may alias the source
+    # arrays, and the donating steps would otherwise delete the other leg's
+    # state out from under it
+    rep_state = replicate(mesh, jax.tree.map(jnp.copy, state))
+
+    sh_step = make_dp_train_step(
+        model, tx, mesh, sharded_update=ShardedUpdate(layout=layout),
+        state=sh_state)
+    rep_step = make_dp_train_step(model, tx, mesh)
+
+    def timed(step, st, iters=30, warmup=5):
+        for _ in range(warmup):
+            st, m = step(st, batch)
+        jax.device_get(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            st, m = step(st, batch)
+        jax.device_get(m["loss"])  # execution fence
+        return (time.perf_counter() - t0) / iters * 1e3, st
+
+    ms_rep, rep_state = timed(rep_step, rep_state)
+    ms_sh, sh_state = timed(sh_step, sh_state)
+
+    # parity guard: the two schemes must be walking the same trajectory
+    rep_l = jax.tree.leaves(rep_state.params)
+    sh_l = jax.tree.leaves(sh_state.params)
+    max_dev = max(
+        float(jnp.max(jnp.abs(a - b))) for a, b in zip(rep_l, sh_l))
+
+    elem = 4  # f32
+    ring = (n - 1) / n
+    # mutable opt-state elements per chip (count leaves excluded: scalars)
+    opt_elems = {
+        "adam": 2 * p_count,        # mu + nu
+        "momentum": p_count,        # trace
+    }[OPTIMIZER]
+    pad = sum(layout.bucket_sizes) - p_count
+    result = {
+        "metric": "dp_sharded_update",
+        "n_devices": n,
+        "optimizer": OPTIMIZER,
+        "param_count": p_count,
+        "buckets": list(layout.bucket_sizes),
+        "bucket_pad_elems": pad,
+        "step_time_ms_replicated": round(ms_rep, 3),
+        "step_time_ms_sharded": round(ms_sh, 3),
+        "sharded_over_replicated": round(ms_sh / ms_rep, 4),
+        "max_param_deviation": max_dev,  # trajectory parity between schemes
+        # analytic per-chip model (ring collectives, f32):
+        #   replicated: all-reduce(grads)          = 2(N-1)/N · P
+        #   sharded:    reduce-scatter(grads)      =  (N-1)/N · P
+        #             + all-gather(updated params) =  (N-1)/N · P
+        # equal wire bytes — the win is the optimizer terms below
+        "modeled_comm_bytes_per_chip": {
+            "replicated_allreduce": int(2 * ring * p_count * elem),
+            "sharded_reduce_scatter": int(ring * p_count * elem),
+            "sharded_param_all_gather": int(ring * p_count * elem),
+        },
+        "opt_update_elems_per_chip": {
+            "replicated": p_count,
+            "sharded": int(-(-p_count // n)),
+        },
+        "opt_state_bytes_per_chip": {
+            "replicated": opt_elems * elem,
+            "sharded": int(-(-opt_elems // n)) * elem,
+        },
+        "device": str(jax.devices()[0]),
+        "note": (
+            "virtual CPU mesh: step times show parity/no-regression, not "
+            "ICI wire time; comm/memory columns are the analytic model"
+        ),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the ROADMAP.md line, verbatim.  Run from the repo root:
+#
+#     bash scripts/tier1.sh
+#
+# Prints DOTS_PASSED=<count> (passing tests seen before the 870 s budget
+# expires — the suite is larger than the budget on a 1-core box, so this
+# count, not a clean exit, is the comparable figure) and exits with
+# pytest's status (124 = timeout budget reached).
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -170,3 +170,43 @@ def test_grouped_bwd_long_row_matches_two_kernel(window, monkeypatch):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5, err_msg=name
         )
+
+
+def test_grouped_bwd_prime_tile_count_falls_back(monkeypatch):
+    """Group-sizing collapse regression (ADVICE.md r5): a PRIME q-tile count
+    has no divisor under the VMEM budget, so the old sizing walked n_qg down
+    to 1 and emitted n_q full-length f32 partial dK/dV buffers — a transient
+    2 x (bh, n_q, sp, d) HBM spike.  With the ``_GROUPED_MAX_GROUPS`` cap
+    the kernel must instead fall back to the two-kernel scheme (grouped
+    kernel NOT invoked) and still produce the same gradients."""
+    from distributed_tensorflow_ibm_mnist_tpu.ops import flash_attention as fa
+
+    # 13 tiles of 32 rows: n_q = 13 (prime); a one-tile group budget would
+    # collapse to n_qg=1 -> G=13 > _GROUPED_MAX_GROUPS
+    q, k, v = _qkv(b=1, s=13 * 32, h=1, d=16, seed=7)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    monkeypatch.setattr(fa, "_BLOCK_Q", 32)
+    monkeypatch.setattr(fa, "_BLOCK_K", 32)
+    monkeypatch.setattr(fa, "_FUSED_DQ_VMEM_BUDGET", 0)
+    monkeypatch.setattr(fa, "_GROUPED_DQ_VMEM_BUDGET", 32 * 16 * (4 + 4))
+    assert fa._GROUPED_BWD and fa._GROUPED_MAX_GROUPS < 13
+    grouped_ran = []
+    orig_kernel = fa._grouped_bwd_kernel
+    monkeypatch.setattr(
+        fa, "_grouped_bwd_kernel",
+        lambda *a, **kw: (grouped_ran.append(1), orig_kernel(*a, **kw))[1],
+    )
+    g_capped = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert not grouped_ran, (
+        "prime tile count must fall back to the two-kernel scheme, not run "
+        "the grouped kernel with collapsed 1-tile groups"
+    )
+    monkeypatch.setattr(fa, "_GROUPED_BWD", False)
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_capped, g_split):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, err_msg=name
+        )

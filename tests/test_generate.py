@@ -185,6 +185,12 @@ def test_filter_validation():
         make_generator(model, max_len=16, max_new=4, temperature=1.0, top_p=1.5)
     with pytest.raises(ValueError, match="top_k"):
         make_generator(model, max_len=16, max_new=4, temperature=1.0, top_k=-2)
+    # unroll=0 used to reach lax.scan and die with an opaque shape error
+    # deep in the loop machinery (ADVICE.md r5); it must refuse up front
+    with pytest.raises(ValueError, match="unroll"):
+        make_generator(model, max_len=16, max_new=4, unroll=0)
+    with pytest.raises(ValueError, match="unroll"):
+        make_generator(model, max_len=16, max_new=4, unroll=-1)
 
 
 def test_flash_prefill_cache_matches_decode_prefill():
@@ -867,3 +873,48 @@ def test_on_mesh_int8_cache_decodes(eight_devices):
     single = t.generate(prompt, max_new=6)
     meshed = t.generate(prompt, max_new=6, on_mesh=True)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(meshed))
+
+
+@pytest.mark.parametrize("name,mk", [
+    ("base", {}),
+    ("gqa_window", {"heads_kv": 2, "window": 8}),
+    ("moe", {"moe_every": 1, "n_experts": 2}),
+    ("tied", {"tie_embeddings": True}),
+])
+def test_decode_params_cast_bit_exact(name, mk):
+    """_decode_params' compute-dtype cast must be invisible (ADVICE.md r5):
+    for every zoo LM config the default-path decode logits are BIT-identical
+    with the cast copy vs the f32 masters.  The cast commutes only because
+    flax itself casts Dense/Embed/Conv weights per use while the exempted
+    leaves (norm_*, moe) are consumed at param dtype — a future f32-consumed
+    leaf under a new module name would break exactly this assertion."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        name=f"cast_{name}", model="causal_lm",
+        model_kwargs={"dim": 32, "depth": 2, "heads": 4, **mk},
+        dataset="retrieval", dataset_kwargs={"vocab": 32, "seq_len": 16},
+        n_train=32, n_test=8, batch_size=8, epochs=1, quiet=True,
+        eval_batch_size=8,
+    )
+    t = Trainer(cfg)
+    cast = t._decode_params()
+    raw = t.state.params
+    # the cast really happened (bf16 compute dtype) on a castable leaf...
+    assert cast["embed"]["embedding"].dtype == jnp.bfloat16
+    # ...and the exempted families kept their master dtype
+    assert cast["norm_out"]["scale"].dtype == raw["norm_out"]["scale"].dtype
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+
+    # prefill logits: one full forward consuming every leaf family
+    lc = t.model.apply({"params": cast}, prompt)
+    lr = t.model.apply({"params": raw}, prompt)
+    assert lc.dtype == lr.dtype
+    np.testing.assert_array_equal(
+        np.asarray(lc, np.float32), np.asarray(lr, np.float32))
+
+    # full greedy decode (the incremental step consumes the same leaves)
+    out_cast = t.generate(prompt, max_new=4)  # routes through _decode_params
+    out_raw = make_generator(t.model, max_len=12, max_new=4)(raw, prompt)
+    np.testing.assert_array_equal(np.asarray(out_cast), np.asarray(out_raw))
