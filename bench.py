@@ -17,11 +17,13 @@ headline task (the MNIST CNN of SURVEY.md §2.1):
   precisely what this design removes.);
 
 plus MFU (fraction of the chip's bf16 peak, from XLA's cost analysis of the
-compiled epoch — see docs/PERFORMANCE.md for the denominator), and a
+compiled epoch — see docs/PERFORMANCE.md for the denominator), a
 ``dp_sharded_update`` MULTICHIP comparison block (ZeRO-1 sharded vs
 replicated weight update on a subprocess-armed dp=8 virtual mesh: step
 times + the analytic per-chip comm/compute/memory model —
-scripts/bench_sharded_update.py).
+scripts/bench_sharded_update.py), and a ``serving`` comparison block
+(continuous batching vs static one-shot batching on a mixed-length
+request stream — scripts/bench_serving.py).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -244,6 +246,45 @@ def main() -> None:
 
             print(f"bench: dp_sharded_update phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 5 — the serving comparison: continuous batching (serving/
+    # engine.py) vs static one-shot batching on a mixed-length synthetic
+    # request stream (ISSUE 2).  Runs scripts/bench_serving.py in a
+    # SUBPROCESS on the CPU backend so this process's accelerator backend
+    # is untouched; the block reports sustained useful tokens/sec for both
+    # legs (identical greedy output enforced), TTFT percentiles, and slot
+    # occupancy.  Skippable; never sinks the headline.
+    serving = None
+    if not os.environ.get("DTM_BENCH_SKIP_SERVING"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_serving.py")],
+                capture_output=True, text=True, timeout=420, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "serving":
+                    serving = rec
+            if serving is None:
+                print(
+                    f"bench: serving subprocess produced no record "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            print(f"bench: serving phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -309,6 +350,10 @@ def main() -> None:
         # nested under its own name already)
         result["dp_sharded_update"] = {
             k: v for k, v in sharded.items() if k != "metric"
+        }
+    if serving is not None:
+        result["serving"] = {
+            k: v for k, v in serving.items() if k != "metric"
         }
     print(json.dumps(result), flush=True)
 

@@ -48,6 +48,15 @@ beyond its trained length.
     tokens = gen(params, prompt)                       # greedy
     tokens = gen(params, prompt, rng=key)              # sampled if temperature>0
     tokens = gen(params, prompt, prompt_lens=lens)     # ragged batch
+
+Round 6 split the episode into STEPWISE primitives the continuous-batching
+serving engine (serving/engine.py) composes on the host: ``make_prefill``
+(cache + last-position logits, exposed between calls), ``make_decode_step``
+(one batched token step against a caller-owned cache), and ``init_cache``
+(a zeroed slot cache in the decode layout).  ``make_generator`` is
+re-expressed on the same ``_prefill_core``/``_decode_step_core`` math, so
+the fused offline episode and the serving path cannot drift apart
+(greedy parity is pinned in tests/test_serving.py).
 """
 
 from __future__ import annotations
@@ -99,6 +108,113 @@ def _cache_from_sown(intermediates, lens, max_len: int,
             "its TransformerBlocks (CausalLM does)"
         )
     return cache
+
+
+def _prefill_core(model, params, prompt, lens, max_len: int):
+    """The prefill math shared by :func:`make_generator` (one fused program)
+    and :func:`make_prefill` (standalone jit for the serving engine): run the
+    right-padded (B, P) prompt through the NORMAL forward (flash-friendly —
+    see the in-``_gen`` note) with each block sowing its rotated K/V,
+    assemble the (B, max_len) decode cache with every cursor at its row's
+    real length, and return the logits at each row's last real position."""
+    logits, vars_ = model.apply(
+        {"params": params}, prompt, mutable=["intermediates"],
+    )
+    cache = _cache_from_sown(
+        vars_["intermediates"], lens, max_len,
+        getattr(model, "kv_cache_dtype", "native"))
+    last = jnp.take_along_axis(
+        logits, (lens - 1)[:, None, None], axis=1)[:, 0]  # (B, V)
+    return cache, last
+
+
+def _decode_step_core(model, params, cache, tok, max_len: int, ragged: bool):
+    """One batched decode step shared by :func:`make_generator` and
+    :func:`make_decode_step`: append each row's token at its cursor, attend
+    its causal prefix, return (updated cache, (B, V) next-token logits)."""
+    step_logits, vars_ = model.apply(
+        {"params": params, "cache": cache}, tok[:, None],
+        decode=True, max_len=max_len, ragged=ragged,
+        mutable=["cache"],
+    )
+    return vars_["cache"], step_logits[:, 0]
+
+
+def make_prefill(model, max_len: int) -> Callable:
+    """Build a jitted ``prefill(params, prompt, prompt_lens=None) ->
+    (cache, last_logits)`` — the stepwise HALF-program the serving engine
+    (serving/engine.py) composes with :func:`make_decode_step`.
+
+    Unlike :func:`make_generator` (which hides the cache inside one compiled
+    episode), this EXPOSES the decode-cache pytree between calls: the caller
+    owns it, can insert prefilled rows into a larger slot cache, and can run
+    any number of decode steps against it.  ``prompt`` is (B, P) int tokens
+    with P <= max_len; ``prompt_lens`` (B,) marks real lengths in a
+    right-padded batch (None = full rows).  Returns the cache (every block's
+    K/V padded to max_len, cursors at the per-row lengths) and the (B, V)
+    logits at each row's last real position — pick from these for the first
+    generated token.  Compiles once per (B, P) shape; bucket prompt lengths
+    (serving/scheduler.py) to bound the shape set.
+    """
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    if getattr(model, "sow_kv", None) is False:
+        model = model.clone(sow_kv=True)  # arm the flash-prefill capture
+
+    @jax.jit
+    def prefill(params, prompt, prompt_lens=None):
+        b, p = prompt.shape
+        if p > max_len:
+            raise ValueError(
+                f"prompt length {p} exceeds max_len ({max_len})")
+        prompt = prompt.astype(jnp.int32)
+        lens = (
+            jnp.full((b,), p, jnp.int32) if prompt_lens is None
+            else jnp.asarray(prompt_lens, jnp.int32)
+        )
+        return _prefill_core(model, params, prompt, lens, max_len)
+
+    return prefill
+
+
+def make_decode_step(model, max_len: int, ragged: bool = True) -> Callable:
+    """Build a jitted ``step(params, cache, tok) -> (cache, logits)`` — one
+    batched single-token decode across every cache row.
+
+    ``tok`` is (B,) int32 (each row's previous token), ``cache`` the pytree
+    from :func:`make_prefill` / :func:`init_cache`; the returned logits are
+    (B, V) at the new positions.  ``ragged=True`` (the default — the serving
+    engine multiplexes independent requests, so cursors always differ) keeps
+    the per-row cursor machinery; ``ragged=False`` is the uniform
+    scalar-cursor fast path for lockstep batches (models/transformer.py
+    ``ragged``).  Rows whose cursor the caller doesn't care about (free
+    engine slots) decode garbage into their OWN rows only — cache writes are
+    per-row, so occupied slots are unaffected.
+    """
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+
+    @jax.jit
+    def step(params, cache, tok):
+        return _decode_step_core(
+            model, params, cache, tok.astype(jnp.int32), max_len, ragged)
+
+    return step
+
+
+def init_cache(model, params, batch: int, max_len: int):
+    """A zeroed (batch, max_len) decode-cache pytree in the model's decode
+    layout (same structure/dtypes a real prefill produces) — the serving
+    engine's slot cache before any request is admitted.  Built from
+    ``jax.eval_shape`` of the decode apply, so no forward pass runs."""
+    shapes = jax.eval_shape(
+        lambda p: model.apply(
+            {"params": p}, jnp.zeros((batch, 1), jnp.int32),
+            decode=True, max_len=max_len, ragged=True, mutable=["cache"],
+        )[1]["cache"],
+        params,
+    )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
 def _filter_logits(logits, top_k: int, top_p: float):
@@ -251,16 +367,11 @@ def make_generator(
         # scores, OOM for long prompts; this path is O(P^2)-blockwise
         # through the kernel and never materializes more.  Right-padded
         # ragged rows ride through unchanged: causal attention keeps real
-        # tokens from seeing the pads after them.
-        logits, vars_ = model.apply(
-            {"params": params}, prompt, mutable=["intermediates"],
-        )
-        cache = _cache_from_sown(
-            vars_["intermediates"], lens, max_len,
-            getattr(model, "kv_cache_dtype", "native"))
+        # tokens from seeing the pads after them.  (_prefill_core is the
+        # same math make_prefill jits standalone — the serving engine's
+        # half-program; here it inlines into the one fused episode.)
+        cache, last = _prefill_core(model, params, prompt, lens, max_len)
         # each row's first sample comes from ITS last real position
-        last = jnp.take_along_axis(
-            logits, (lens - 1)[:, None, None], axis=1)[:, 0]  # (B, V)
         rngs = jax.random.split(rng, max_new)
         first = pick(last, rngs[0])
         finished = (
@@ -287,16 +398,15 @@ def make_generator(
         ragged = prompt_lens is not None
 
         def step(cache, tok, finished, step_rng):
-            step_logits, vars_ = model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                decode=True, max_len=max_len, ragged=ragged,
-                mutable=["cache"],
-            )
-            nxt = pick(step_logits[:, 0], step_rng)
+            # same batched step make_decode_step jits standalone for the
+            # serving engine — inlined here into the fused episode
+            cache, step_logits = _decode_step_core(
+                model, params, cache, tok, max_len, ragged)
+            nxt = pick(step_logits, step_rng)
             if eos_id is not None:
                 nxt = jnp.where(finished, pad_id, nxt)
                 finished = finished | (nxt == eos_id)
-            return vars_["cache"], nxt, finished
+            return cache, nxt, finished
 
         if eos_id is None:
             # static trip count -> lax.scan (XLA pipelines it measurably

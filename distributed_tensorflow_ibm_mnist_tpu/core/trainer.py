@@ -118,6 +118,10 @@ class Trainer:
 
     def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None):
         self.config = config
+        # the trainer OWNS the writer only when it built one itself — a
+        # caller-supplied writer (bench harnesses sharing one log) must
+        # survive this trainer's close()
+        self._owns_writer = writer is None
         self.writer = writer or MetricWriter(path=config.metrics_path, stdout=not config.quiet)
         _enable_compile_cache(config.compile_cache_dir)
 
@@ -1262,6 +1266,23 @@ class Trainer:
             cache[key] = gen
         params = self.state.params if on_mesh else self._decode_params()
         return gen(params, prompt, rng=rng, prompt_lens=prompt_lens)
+
+    def close(self) -> None:
+        """Release the trainer's metric writer (file handle + TensorBoard).
+
+        Only closes a writer the trainer built itself; caller-supplied
+        writers are the caller's to close.  Idempotent."""
+        if self._owns_writer:
+            self.writer.close()
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        # MetricWriter's own context-manager contract, delegated: the
+        # metrics file handle is released even when fit() raises mid-run
+        self.close()
+        return False
 
     def evaluate(self) -> dict[str, float]:
         out = jax.device_get(self._eval(self.state, self.test_images, self.test_labels))

@@ -91,6 +91,31 @@ def quantize_kv_int8(x):
     return q.astype(jnp.int8), scale
 
 
+def reset_cache_slots(cache, slot_mask):
+    """Zero the decode-cache state of selected batch rows: K/V payloads,
+    int8 scales, and the (B,) write cursor of every row where ``slot_mask``
+    is True, leaving other rows untouched.
+
+    This is the per-slot reset the continuous-batching serving engine
+    (serving/engine.py) runs when it retires a request: the freed slot's
+    cursor returns to 0 so an idle slot's lockstep decode steps stay inside
+    its own (max_len,) row, and the next admitted request starts from a
+    clean row.  Every leaf of the cache pytree is (B, ...)-leading
+    (``_decode_attention`` keeps the cursor (B,)-shaped in both ragged
+    modes), so one broadcasted ``where`` per leaf suffices — cheap enough
+    to jit per retire batch.
+    """
+    import jax
+
+    mask = jnp.asarray(slot_mask, bool)
+
+    def _reset(leaf):
+        m = mask.reshape(mask.shape + (1,) * (leaf.ndim - 1))
+        return jnp.where(m, jnp.zeros_like(leaf), leaf)
+
+    return jax.tree.map(_reset, cache)
+
+
 def _resolve_attn(attn_fn: Callable | None, attn: str) -> Callable:
     """attn_fn (explicit callable, e.g. a ring-attention island) wins; else
     pick by name: 'vanilla' (XLA) or 'flash' (the Pallas kernel) — a string

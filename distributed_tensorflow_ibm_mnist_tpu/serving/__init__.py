@@ -1,0 +1,32 @@
+"""Continuous-batching serving: host-side scheduling over compiled decode.
+
+The first inference-side subsystem (ISSUE 2): a fixed-shape compiled
+decode-step program stays resident while a host loop multiplexes a stream
+of variable-length requests through its batch slots — the TF-Replicator /
+Mesh-TensorFlow separation of device program from execution driver
+(PAPERS.md), applied to serving.
+
+* :class:`~.engine.InferenceEngine` — the slot-multiplexed host loop
+* :class:`~.scheduler.FIFOScheduler` / :class:`~.scheduler.Request` —
+  bounded FIFO admission with prompt-length bucketing and deadlines
+* :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
+  slot occupancy, emitted through :class:`~..utils.metrics.MetricWriter`
+
+See docs/SERVING.md for the architecture and knobs.
+"""
+
+from distributed_tensorflow_ibm_mnist_tpu.serving.engine import InferenceEngine
+from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
+    FIFOScheduler,
+    QueueFull,
+    Request,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
+
+__all__ = [
+    "InferenceEngine",
+    "FIFOScheduler",
+    "QueueFull",
+    "Request",
+    "ServingStats",
+]

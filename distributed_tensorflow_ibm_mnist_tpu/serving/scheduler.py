@@ -1,0 +1,150 @@
+"""Admission control for the continuous-batching engine: FIFO + buckets.
+
+The host side of the TF-Replicator / Mesh-TensorFlow split the serving
+design follows (PAPERS.md): the DEVICE program is fixed-shape (one compiled
+decode step, a small set of padded prefill shapes); everything variable —
+arrival order, queue depth, deadlines — lives here, in plain Python the
+compiler never sees.
+
+Three jobs:
+
+* **Bucketing** — a prompt admitted at its raw length would compile a
+  fresh prefill program per distinct length.  ``buckets`` is the closed set
+  of padded prefill shapes: a prompt rides in the smallest bucket that
+  fits, right-padded with ``pad_id`` (the causal mask keeps real tokens
+  from seeing the pads — models/transformer.py ``_decode_attention``), so
+  the engine compiles at most ``len(buckets)`` prefill programs, ever.
+* **Backpressure** — the queue is bounded (``max_queue``); ``submit`` on a
+  full queue raises :class:`QueueFull` instead of buffering unboundedly.
+  The caller (a request handler) turns that into load-shedding/429s.
+* **Deadlines** — a request may carry ``deadline_s`` (seconds from
+  submit).  Overdue QUEUED requests are cancelled at pop time (never
+  admitted — prefilling a request that cannot finish wastes the slot);
+  overdue RUNNING rows are cancelled by the engine's per-iteration sweep.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Bounded-queue backpressure: the caller must retry or shed load."""
+
+
+@dataclass
+class Request:
+    """One generation request and its lifecycle record.
+
+    The scheduler fills the identity/admission fields; the engine fills the
+    timing/output fields as the request moves through a slot.  ``status``
+    walks queued -> running -> (done | cancelled).
+    """
+
+    id: int
+    tokens: np.ndarray          # (len,) int32 — the real (unpadded) prompt
+    max_new: int                # generation budget (EOS may stop earlier)
+    bucket: int                 # padded prefill length the prompt rides in
+    deadline_s: float | None    # seconds from submit; None = no deadline
+    submit_t: float             # scheduler clock at submit
+    admit_t: float | None = None        # engine: slot admission (prefill)
+    first_token_t: float | None = None  # engine: first token on host (TTFT)
+    finish_t: float | None = None       # engine: retirement
+    generated: list[int] = field(default_factory=list)  # engine: output
+    status: str = "queued"
+
+    @property
+    def overdue_at(self) -> float:
+        return np.inf if self.deadline_s is None else self.submit_t + self.deadline_s
+
+
+class FIFOScheduler:
+    """Bounded FIFO request queue with prompt-length bucketing.
+
+    ``max_len`` is the engine's KV-cache length: a request must satisfy
+    ``len(prompt) + max_new <= max_len`` (its slot cursor may never run off
+    the cache) and fit some bucket.  ``clock`` is injectable for tests.
+    """
+
+    def __init__(self, max_len: int, buckets: tuple[int, ...] = (16, 32, 64, 128),
+                 max_queue: int = 64, clock: Callable[[], float] = time.monotonic):
+        if not buckets:
+            raise ValueError("need at least one prefill bucket")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_len = max_len
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        if self.buckets[-1] > max_len:
+            raise ValueError(
+                f"largest bucket ({self.buckets[-1]}) exceeds max_len "
+                f"({max_len}) — a prompt that long could never prefill"
+            )
+        self.max_queue = max_queue
+        self.clock = clock
+        self._queue: deque[Request] = deque()
+        self._ids = itertools.count()
+        self.cancelled: list[Request] = []  # overdue-before-admission
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket holding an n-token prompt; raises if none does."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds the largest prefill bucket "
+            f"({self.buckets[-1]}) — raise buckets= or shorten the prompt"
+        )
+
+    def submit(self, prompt, max_new: int, deadline_s: float | None = None) -> Request:
+        """Enqueue one request; raises :class:`QueueFull` (backpressure) or
+        ``ValueError`` (request can never be served)."""
+        tokens = np.asarray(prompt, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if tokens.size + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({tokens.size}) + max_new ({max_new}) exceeds the "
+                f"engine cache length ({self.max_len})"
+            )
+        bucket = self.bucket_for(tokens.size)
+        if len(self._queue) >= self.max_queue:
+            raise QueueFull(
+                f"request queue full ({self.max_queue}) — retry later or "
+                "shed load (bounded-queue backpressure)"
+            )
+        req = Request(id=next(self._ids), tokens=tokens, max_new=int(max_new),
+                      bucket=bucket, deadline_s=deadline_s,
+                      submit_t=self.clock())
+        self._queue.append(req)
+        return req
+
+    def pop(self, now: float | None = None) -> Request | None:
+        """Next admissible request (FIFO), or None.  Overdue queued
+        requests are cancelled in passing, never returned — admitting a
+        request that already blew its deadline would waste the prefill and
+        the slot."""
+        now = self.clock() if now is None else now
+        while self._queue:
+            req = self._queue.popleft()
+            if now > req.overdue_at:
+                req.status = "cancelled"
+                req.finish_t = now
+                self.cancelled.append(req)
+                continue
+            return req
+        return None

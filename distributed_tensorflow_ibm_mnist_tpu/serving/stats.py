@@ -1,0 +1,110 @@
+"""Serving metrics: per-request TTFT/latency, engine tokens/sec, occupancy.
+
+The serving analog of the trainer's metrics-of-record discipline
+(utils/metrics.py): every number a capacity plan needs, as one JSON record.
+
+* **TTFT** (time-to-first-token) — submit to the first token being ON THE
+  HOST (the prefill's pick), the user-visible responsiveness figure.  Queue
+  wait is inside it by construction: a request that sat behind a full
+  batch shows it here, which is exactly what head-of-line blocking looks
+  like in data.
+* **latency** — submit to retirement (EOS / budget / deadline-cancel).
+* **tokens/sec** — real generated tokens over the engine's busy window
+  (first admission to last retirement): the SUSTAINED figure continuous
+  batching improves, not a per-step peak.
+* **occupancy** — time-weighted mean fraction of slots holding a live
+  request.  Static batching's head-of-line blocking shows up directly as
+  occupancy lost to retired-but-still-decoding rows; the refill loop keeps
+  it near 1 under load.
+
+Percentiles are p50/p95/p99 over completed requests (cancelled requests
+count in TTFT if they got a first token, and in the cancel counter, not in
+latency — a deadline kill is not a service time).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import Request
+from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+
+def percentiles(xs, qs=(50, 95, 99)) -> dict[str, float]:
+    """{"p50": ..., "p95": ..., "p99": ...} over xs (empty -> None values)."""
+    if not len(xs):
+        return {f"p{q}": None for q in qs}
+    arr = np.asarray(xs, np.float64)
+    return {f"p{q}": round(float(np.percentile(arr, q)), 6) for q in qs}
+
+
+class ServingStats:
+    """Accumulates request records and engine-loop samples.
+
+    The engine calls :meth:`tick` once per host-loop iteration (occupancy
+    integration, weighted by the iteration's wall time) and :meth:`add`
+    once per retired request; :meth:`summary` folds everything into one
+    flat dict and :meth:`emit` writes it through a :class:`MetricWriter`
+    (non-finite values are sanitized to null by the writer itself).
+    """
+
+    def __init__(self, slots: int):
+        self.slots = slots
+        self.requests: list[Request] = []
+        self._occ_time = 0.0   # integral of occupied_slots * dt
+        self._busy_time = 0.0  # integral of dt while the engine had work
+        self._decode_steps = 0
+        self._start_t: float | None = None
+        self._end_t: float | None = None
+
+    def tick(self, occupied: int, dt: float, decoded: bool = False) -> None:
+        self._occ_time += occupied * dt
+        self._busy_time += dt
+        if decoded:
+            self._decode_steps += 1
+
+    def add(self, req: Request) -> None:
+        self.requests.append(req)
+        if req.admit_t is not None:
+            self._start_t = req.admit_t if self._start_t is None else min(
+                self._start_t, req.admit_t)
+        if req.finish_t is not None:
+            self._end_t = req.finish_t if self._end_t is None else max(
+                self._end_t, req.finish_t)
+
+    def summary(self) -> dict:
+        done = [r for r in self.requests if r.status == "done"]
+        cancelled = [r for r in self.requests if r.status == "cancelled"]
+        ttft = [r.first_token_t - r.submit_t for r in self.requests
+                if r.first_token_t is not None]
+        latency = [r.finish_t - r.submit_t for r in done
+                   if r.finish_t is not None]
+        n_tokens = sum(len(r.generated) for r in self.requests)
+        window = (
+            (self._end_t - self._start_t)
+            if self._start_t is not None and self._end_t is not None
+            and self._end_t > self._start_t else None
+        )
+        out = {
+            "slots": self.slots,
+            "n_requests": len(self.requests),
+            "n_done": len(done),
+            "n_cancelled": len(cancelled),
+            "tokens_generated": int(n_tokens),
+            "tokens_per_sec": (
+                round(n_tokens / window, 3) if window else None
+            ),
+            "busy_s": round(self._busy_time, 6),
+            "decode_steps": self._decode_steps,
+            "slot_occupancy": (
+                round(self._occ_time / (self._busy_time * self.slots), 4)
+                if self._busy_time > 0 else None
+            ),
+        }
+        for name, xs in (("ttft_s", ttft), ("latency_s", latency)):
+            for k, v in percentiles(xs).items():
+                out[f"{name}_{k}"] = v
+        return out
+
+    def emit(self, writer: MetricWriter, kind: str = "serving") -> dict:
+        return writer.write(kind, **self.summary())

@@ -10,15 +10,37 @@ machine-readable JSON lines, with optional TensorBoard event files.
 from __future__ import annotations
 
 import json
+import math
 import time
 from typing import Any, IO
+
+
+def _sanitize(v: Any) -> Any:
+    """JSON-safe metric values: numerics become floats, and non-finite
+    floats become None — ``json.dumps`` would otherwise emit bare ``NaN`` /
+    ``Infinity`` tokens, which are NOT JSON and break every strict consumer
+    of the log (a diverged loss must not corrupt the metrics file it is
+    being recorded in).  Recurses through dicts/lists/tuples so nested
+    blocks (bench.py's comparison sections) get the same guarantee."""
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    if not isinstance(v, (str, bool)) and hasattr(v, "__float__"):
+        v = float(v)
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
 
 
 class MetricWriter:
     """JSON-lines metric writer; one record per event.
 
     Records carry a monotonic ``t`` (seconds since writer creation) so
-    time-to-accuracy can be reconstructed from the log alone.
+    time-to-accuracy can be reconstructed from the log alone.  Usable as a
+    context manager — ``with MetricWriter(path) as w: ...`` closes the file
+    handle (and the TensorBoard writer) even when the body raises, so a
+    crashing run cannot leak the handle or lose buffered events.
     """
 
     def __init__(self, path: str | None = None, stdout: bool = True, tensorboard_dir: str | None = None):
@@ -38,7 +60,7 @@ class MetricWriter:
         record = {"kind": kind, "t": round(time.perf_counter() - self._t0, 4)}
         if step is not None:
             record["step"] = int(step)
-        record.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()})
+        record.update({k: _sanitize(v) for k, v in metrics.items()})
         line = json.dumps(record)
         if self._stdout:
             print(line, flush=True)
@@ -46,8 +68,8 @@ class MetricWriter:
             self._file.write(line + "\n")
             self._file.flush()
         if self._tb and step is not None:
-            for k, v in metrics.items():
-                if isinstance(v, (int, float)):
+            for k, v in record.items():
+                if k not in ("kind", "t", "step") and isinstance(v, (int, float)) and not isinstance(v, bool):
                     self._tb.add_scalar(f"{kind}/{k}", v, step)
         return record
 
@@ -56,3 +78,10 @@ class MetricWriter:
             self._file.close()
         if self._tb:
             self._tb.close()
+
+    def __enter__(self) -> "MetricWriter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -183,6 +183,71 @@ def test_metric_writer_jsonl_and_tensorboard(tmp_path):
     assert event_files, "no tensorboard event files written"
 
 
+def test_metric_writer_context_manager_closes_on_exception(tmp_path):
+    """MetricWriter is a context manager: the file handle is released even
+    when the body raises (the leak the bare-open form had)."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+    path = tmp_path / "m.jsonl"
+    with MetricWriter(path=str(path), stdout=False) as w:
+        w.write("epoch", step=1, loss=0.5)
+    assert w._file.closed
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with MetricWriter(path=str(path), stdout=False) as w2:
+            w2.write("epoch", step=2, loss=0.4)
+            raise RuntimeError("boom")
+    assert w2._file.closed  # closed despite the exception
+    assert len(path.read_text().splitlines()) == 2  # both records landed
+
+    # Trainer delegates: a self-built writer closes with the trainer
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(model="mlp", synthetic=True, n_train=64, n_test=32,
+                    batch_size=32, epochs=1, quiet=True,
+                    metrics_path=str(tmp_path / "t.jsonl"))
+    with Trainer(cfg) as t:
+        assert not t.writer._file.closed
+    assert t.writer._file.closed
+    # ...but never a caller-supplied one (the caller owns its lifecycle)
+    shared = MetricWriter(path=str(tmp_path / "shared.jsonl"), stdout=False)
+    with Trainer(cfg.replace(name="shared_writer"), writer=shared):
+        pass
+    assert not shared._file.closed
+    shared.close()
+
+
+def test_metric_writer_sanitizes_non_finite_to_null(tmp_path):
+    """NaN/Infinity metric values must round-trip as STRICT JSON null, not
+    json.dumps's bare NaN/Infinity tokens (invalid JSON) — including inside
+    nested blocks like bench.py's comparison sections."""
+    import json
+    import math
+
+    from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+    path = tmp_path / "nan.jsonl"
+    with MetricWriter(path=str(path), stdout=False) as w:
+        rec = w.write(
+            "epoch", step=1, loss=float("nan"), grad_norm=float("inf"),
+            ratio=float("-inf"), ok=1.5, tag="run",
+            nested={"a": float("nan"), "b": [2.0, float("inf")]})
+    line = path.read_text().splitlines()[0]
+    parsed = json.loads(line)  # strict parse: bare NaN tokens would raise
+    assert json.loads(line, parse_constant=lambda s: pytest.fail(
+        f"non-finite token {s!r} leaked into the JSON")) == parsed
+    assert parsed["loss"] is None and parsed["grad_norm"] is None
+    assert parsed["ratio"] is None
+    assert parsed["ok"] == 1.5 and parsed["tag"] == "run"
+    assert parsed["nested"] == {"a": None, "b": [2.0, None]}
+    # the returned record mirrors what was written
+    assert rec["loss"] is None and rec["nested"]["b"][1] is None
+    assert not any(
+        isinstance(v, float) and not math.isfinite(v) for v in parsed.values()
+        if isinstance(v, float))
+
+
 def test_hostmesh_ensure_virtual_cpu_devices():
     """ensure_virtual_cpu_devices is a no-op when already satisfied and
     reports the live device count."""

@@ -193,6 +193,37 @@ def test_filter_validation():
         make_generator(model, max_len=16, max_new=4, unroll=-1)
 
 
+def test_generator_error_paths():
+    """The make_generator refusals a serving stack leans on (ISSUE 2
+    satellite): eos==pad, max_new<1, and prompt+max_new exceeding the
+    cache — each a clear ValueError, never a silent cache corruption."""
+    model, params = _model_and_params(seed=17)
+    with pytest.raises(ValueError, match="pad_id"):
+        make_generator(model, max_len=16, max_new=4, eos_id=3, pad_id=3)
+    with pytest.raises(ValueError, match="max_new"):
+        make_generator(model, max_len=16, max_new=0)
+    with pytest.raises(ValueError, match="max_new"):
+        make_generator(model, max_len=16, max_new=-2)
+    # prompt + max_new > max_len surfaces at call time (the prompt length
+    # is a call-site shape), pointing at the overflowing arithmetic
+    gen = make_generator(model, max_len=8, max_new=8)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        gen(params, jnp.asarray([[1, 2, 3, 4]], jnp.int32))
+    # the stepwise primitives refuse the same impossible shapes
+    from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
+        make_decode_step,
+        make_prefill,
+    )
+
+    with pytest.raises(ValueError, match="max_len"):
+        make_prefill(model, max_len=0)
+    with pytest.raises(ValueError, match="max_len"):
+        make_decode_step(model, max_len=0)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        make_prefill(model, max_len=4)(
+            params, jnp.zeros((1, 6), jnp.int32))
+
+
 def test_flash_prefill_cache_matches_decode_prefill():
     """make_generator prefills through the NORMAL forward (flash-friendly,
     no O(P*max_len) score matrix) and assembles the cache from sown K/V —
