@@ -23,7 +23,11 @@ replicated weight update on a subprocess-armed dp=8 virtual mesh: step
 times + the analytic per-chip comm/compute/memory model —
 scripts/bench_sharded_update.py), and a ``serving`` comparison block
 (continuous batching vs static one-shot batching on a mixed-length
-request stream — scripts/bench_serving.py).
+request stream — scripts/bench_serving.py), and a ``chaos`` block (the
+ISSUE 3 fault-injection soak: bit-identical training recovery + isolated
+serving failures under a seeded multi-fault plan, with the zero-overhead
+and manifest-cost guards — scripts/chaos_soak.py, skip with
+DTM_BENCH_SKIP_CHAOS).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -285,6 +289,46 @@ def main() -> None:
 
             print(f"bench: serving phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 6 — the chaos soak (ISSUE 3): seeded multi-fault plans against
+    # training (torn checkpoint write, NaN step, checkpoint-read + data-
+    # batch I/O faults -> bit-identical recovery) and serving (poisoned
+    # request, raising callback, transient decode fault -> identical
+    # outputs for every non-poisoned request), plus the zero-overhead
+    # guard for disabled chaos hooks and the manifest cost per checkpoint.
+    # Runs scripts/chaos_soak.py in a SUBPROCESS on the CPU backend.
+    # Skippable; never sinks the headline.
+    chaos = None
+    if not os.environ.get("DTM_BENCH_SKIP_CHAOS"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "chaos_soak.py")],
+                capture_output=True, text=True, timeout=540, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "chaos":
+                    chaos = rec
+            if chaos is None:
+                print(
+                    f"bench: chaos subprocess produced no record "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            print(f"bench: chaos phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -354,6 +398,10 @@ def main() -> None:
     if serving is not None:
         result["serving"] = {
             k: v for k, v in serving.items() if k != "metric"
+        }
+    if chaos is not None:
+        result["chaos"] = {
+            k: v for k, v in chaos.items() if k != "metric"
         }
     print(json.dumps(result), flush=True)
 

@@ -116,8 +116,13 @@ def _unknown_sp_impl_msg(sp_impl: str) -> str:
 class Trainer:
     """Owns the compiled functions + train state for one run."""
 
-    def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None):
+    def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None,
+                 chaos=None):
         self.config = config
+        # utils/chaos.FaultInjector | None — every chaos site below guards
+        # with `is not None`, so an unwired trainer runs zero chaos
+        # instructions on its hot paths (asserted by scripts/chaos_soak.py)
+        self._chaos = chaos
         # the trainer OWNS the writer only when it built one itself — a
         # caller-supplied writer (bench harnesses sharing one log) must
         # survive this trainer's close()
@@ -571,7 +576,7 @@ class Trainer:
         if config.checkpoint_dir:
             from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
 
-            self._ckpt = CheckpointManager(config.checkpoint_dir)
+            self._ckpt = CheckpointManager(config.checkpoint_dir, chaos=chaos)
 
     def _make_pipeline_fn(self):
         """The pp>1 block-stack hook: GPipe island when the batch divides
@@ -830,24 +835,45 @@ class Trainer:
         return self._ckpt.save(state, wait=wait)
 
     def restore_checkpoint(self, step: int | None = None) -> int:
-        """Resume from the checkpoint dir; returns the restored step."""
+        """Resume from the checkpoint dir; returns the restored step.
+
+        With ``step=None`` the restore is the HARDENED form
+        (``CheckpointManager.restore_latest_intact``): torn/corrupt/
+        non-finite steps are walked past, newest → oldest, instead of
+        crashing the resume — a crash mid-save costs at most the epochs
+        since the previous durable step.  An explicit ``step`` restores
+        exactly that step (and raises on corruption), for forensics.
+        """
         if self._ckpt is None:
             raise ValueError("no checkpoint_dir configured")
         # the live state is the restore target: its shardings steer orbax to
         # load each leaf directly into this run's layout (no host staging);
         # _place_state is then a no-op re-assert of the placement contract
-        restored = self._ckpt.restore(self.state, step=step)
+        if step is None:
+            restored = self._ckpt.restore_latest_intact(self.state)
+        else:
+            restored = self._ckpt.restore(self.state, step=step)
         self.state = self._place_state(restored)
         self._gen_params = None  # decode-params cache keyed off the old state
         return int(jax.device_get(self.state.step))
 
-    def _run_epoch_stream(self, state, epoch_rng):
+    def _run_epoch_stream(self, state, epoch_rng, preemption=None):
         """One epoch in stream mode: C++-prefetched host batches -> compiled
         steps.  Batches are shipped in chunks of ``stream_chunk`` — ONE
         host->device transfer per chunk, then a compiled scan over its steps —
         so per-step transfer latency (brutal on tunnelled/remote devices) is
         amortized ``stream_chunk``-fold.  Metrics stay device-side until epoch
-        end so the dispatch pipeline never blocks on a host readback."""
+        end so the dispatch pipeline never blocks on a host readback.
+
+        ``preemption`` with ``config.preempt_poll_every > 0`` is polled at
+        step granularity (every poll boundary the flushed-step counter
+        crosses): a SIGTERM mid-epoch stops the epoch at the next boundary
+        with the steps run so far, so the grace window is spent
+        checkpointing, not finishing an epoch that may not fit in it
+        (fit() sees ``triggered`` at the epoch boundary and does the
+        checkpoint-and-exit).  Unrun prefetched batches are dropped — the
+        resumed run replays them (state.step records exactly what ran).
+        """
         from distributed_tensorflow_ibm_mnist_tpu.data.native import Prefetcher
 
         cfg = self.config
@@ -857,11 +883,15 @@ class Trainer:
             : self.steps_per_epoch * cfg.batch_size
         ].astype(np.int32)
         chunk = max(1, cfg.stream_chunk)
+        poll = max(0, cfg.preempt_poll_every)
         ms = []
         pending_imgs: list[np.ndarray] = []
         pending_labs: list[np.ndarray] = []
+        steps_done = 0
+        next_poll = poll
 
         def flush(state):
+            nonlocal steps_done
             k = len(pending_imgs)
             if k == chunk and chunk > 1:
                 batches = {
@@ -877,20 +907,30 @@ class Trainer:
                     batch = {"image": jnp.asarray(img), "label": jnp.asarray(lab)}
                     state, m = self._train_step(state, batch)
                     ms.append(m)
+            steps_done += k
             pending_imgs.clear()
             pending_labs.clear()
             return state
 
+        stopped = False
         with Prefetcher(
             self.train_images, self.train_labels, cfg.batch_size, perm,
             depth=cfg.prefetch_depth,
         ) as pf:
             for img, lab in pf:
+                if self._chaos is not None:
+                    self._chaos.raise_if_fired("data-batch", OSError)
                 pending_imgs.append(img)
                 pending_labs.append(lab)
                 if len(pending_imgs) == chunk:
                     state = flush(state)
-        state = flush(state)
+                    if poll and preemption is not None and steps_done >= next_poll:
+                        next_poll = steps_done + poll
+                        if preemption.triggered:
+                            stopped = True
+                            break
+        if not stopped:
+            state = flush(state)
         # per-chunk metrics are (k,)-stacked; per-step ones are scalars
         flat = {
             k: jnp.concatenate([jnp.atleast_1d(m[k]) for m in ms]) for k in ms[0]
@@ -1336,11 +1376,45 @@ class Trainer:
             if cfg.epochs == 1:
                 prof.start()
 
+        # Data-order schedule is keyed by the ABSOLUTE epoch index (epochs
+        # already durable in the restored step + the local epoch counter):
+        # a resumed run replays exactly the schedule the uninterrupted run
+        # would have had, which is what makes recovery bit-identical
+        # (scripts/chaos_soak.py asserts this end to end).  Fresh runs have
+        # abs_epoch0 == 0 — nothing changes for them.
+        abs_epoch0 = step0 // self.steps_per_epoch
         try:
             for epoch in range(cfg.epochs):
-                epoch_rng = jax.random.fold_in(self._data_rng, epoch)
+                epoch_rng = jax.random.fold_in(self._data_rng, abs_epoch0 + epoch)
+                if self._chaos is not None:
+                    spec = self._chaos.fire("train-step")
+                    if spec is not None:
+                        if spec.kind == "nan":
+                            # poison ONE param element: the epoch's loss goes
+                            # non-finite and the real divergence detector +
+                            # restore path below must recover it
+                            from distributed_tensorflow_ibm_mnist_tpu.utils.debug import (
+                                inject_nan,
+                            )
+
+                            path, _ = jax.tree_util.tree_flatten_with_path(
+                                self.state.params)[0][0]
+                            leaf = "/".join(
+                                str(getattr(k, "key", getattr(k, "name", k)))
+                                for k in path)
+                            self.state = self.state.replace(
+                                params=inject_nan(self.state.params, leaf))
+                        else:
+                            from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+                                ChaosFault,
+                            )
+
+                            raise ChaosFault(
+                                "train-step", spec.kind,
+                                self._chaos.events("train-step") - 1)
                 if self._stream:
-                    self.state, metrics = self._run_epoch_stream(self.state, epoch_rng)
+                    self.state, metrics = self._run_epoch_stream(
+                        self.state, epoch_rng, preemption=preemption)
                 else:
                     self.state, metrics = self._run_epoch(
                         self.state, self.train_images, self.train_labels, epoch_rng

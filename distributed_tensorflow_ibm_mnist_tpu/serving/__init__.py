@@ -15,7 +15,10 @@ Mesh-TensorFlow separation of device program from execution driver
 See docs/SERVING.md for the architecture and knobs.
 """
 
-from distributed_tensorflow_ibm_mnist_tpu.serving.engine import InferenceEngine
+from distributed_tensorflow_ibm_mnist_tpu.serving.engine import (
+    EngineStalled,
+    InferenceEngine,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     FIFOScheduler,
     QueueFull,
@@ -24,6 +27,7 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
 
 __all__ = [
+    "EngineStalled",
     "InferenceEngine",
     "FIFOScheduler",
     "QueueFull",

@@ -30,6 +30,23 @@ engine, never corruption.
 Greedy decode through this loop is token-for-token identical to
 ``make_generator`` (both run the same ``_prefill_core``/
 ``_decode_step_core`` math; pinned in tests/test_serving.py).
+
+Failure hardening (ISSUE 3): failures are isolated at the blast radius
+they actually have.  A fault belonging to ONE request — its prefill
+raising (poisoned prompt, injected ``serving-admit`` chaos) or its user
+``callback`` raising — moves that request to the terminal ``FAILED``
+state (``Request.error`` records why), resets its cache row, and the loop
+keeps serving every other slot.  A fault in the BATCHED decode dispatch
+belongs to all slots: with ``stall_timeout_s`` set, decode exceptions are
+absorbed as no-progress iterations until the watchdog deadline, then the
+engine fails the in-flight requests and raises :class:`EngineStalled`
+cleanly (slots cleared, engine reusable); without a watchdog, the first
+decode fault fails in-flight requests and re-raises immediately.
+``drain()`` (serve everything already accepted, admit nothing new) and
+``close()`` (cancel queued + in-flight, emit stats, refuse further use)
+give supervisors graceful-shutdown semantics.  Chaos sites
+``serving-admit`` / ``serving-step`` / ``serving-callback``
+(utils/chaos.py) inject all three failure shapes on a seeded schedule.
 """
 
 from __future__ import annotations
@@ -51,6 +68,13 @@ from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import FIFOScheduler, Request
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+
+class EngineStalled(RuntimeError):
+    """The watchdog verdict: no token progress across ALL slots within
+    ``stall_timeout_s``.  In-flight requests were already moved to FAILED
+    and their slots reset before this raised — the engine object remains
+    usable (or closeable) by the caller that catches it."""
 
 
 class InferenceEngine:
@@ -79,7 +103,13 @@ class InferenceEngine:
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, writer: MetricWriter | None = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 stall_timeout_s: float | None = None,
+                 chaos=None):
+        if stall_timeout_s is not None and stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0 (None disables the watchdog), "
+                f"got {stall_timeout_s}")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_len < 2:
@@ -161,6 +191,12 @@ class InferenceEngine:
         self._slot_tok = np.full((slots,), self.pad_id, np.int32)
         self._tok_dev = None  # device copy of _slot_tok; None = stale
         self.completed: list[Request] = []
+        # --- failure isolation / shutdown state ---
+        self.stall_timeout_s = stall_timeout_s
+        self._chaos = chaos  # utils/chaos.FaultInjector | None (see module doc)
+        self._last_progress_t: float | None = None  # watchdog anchor
+        self._draining = False  # drain(): serve what's accepted, admit no more
+        self._closed = False
 
     @staticmethod
     def _insert_impl(cache, row_cache, slot):
@@ -199,10 +235,20 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # request lifecycle
 
-    def submit(self, prompt, max_new: int, deadline_s: float | None = None) -> Request:
+    def submit(self, prompt, max_new: int, deadline_s: float | None = None,
+               callback: Callable | None = None) -> Request:
         """Enqueue a request (see :meth:`FIFOScheduler.submit` for the
-        admission rules; raises ``QueueFull`` under backpressure)."""
-        return self.scheduler.submit(prompt, max_new, deadline_s=deadline_s)
+        admission rules; raises ``QueueFull`` under backpressure).
+        ``callback(request, token)`` streams every generated token; if it
+        raises, THIS request fails (terminal ``failed`` state) and the
+        engine keeps serving the rest.  Refused after :meth:`drain` /
+        :meth:`close`."""
+        if self._closed or self._draining:
+            raise RuntimeError(
+                "engine is " + ("closed" if self._closed else "draining")
+                + " — no new requests")
+        return self.scheduler.submit(prompt, max_new, deadline_s=deadline_s,
+                                     callback=callback)
 
     @property
     def occupied(self) -> int:
@@ -231,25 +277,62 @@ class InferenceEngine:
         self.completed.append(req)
         self.stats.add(req)
 
-    def _admit(self, req: Request, slot: int, now: float) -> None:
-        """Prefill ``req`` at its bucket shape and land it in ``slot``."""
-        padded = np.full((1, req.bucket), self.pad_id, np.int32)
-        padded[0, : req.tokens.size] = req.tokens
-        row_cache, first_tok = self._prefill_and_pick(
-            self.params, jnp.asarray(padded),
-            jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
-        self.cache = self._insert(
-            self.cache, row_cache, jnp.asarray(slot, jnp.int32))
-        first = int(first_tok[0])
-        req.admit_t = now
-        req.generated.append(first)
-        req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
-        req.status = "running"
+    def _fail(self, req: Request, exc: BaseException, now: float) -> None:
+        """Move ``req`` to the terminal FAILED state (isolated casualty)."""
+        req.status = "failed"
+        req.error = f"{type(exc).__name__}: {exc}"
+        req.finish_t = now
+        self.completed.append(req)
+        self.stats.add(req)
+
+    def _notify(self, req: Request, tok: int) -> None:
+        """Deliver one token to the request's streaming callback.  Raises
+        propagate to the caller, which fails THIS request only."""
+        if self._chaos is not None:
+            from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import ChaosFault
+
+            self._chaos.raise_if_fired("serving-callback", ChaosFault)
+        if req.callback is not None:
+            req.callback(req, tok)
+
+    def _admit(self, req: Request, slot: int, now: float) -> bool:
+        """Prefill ``req`` at its bucket shape and land it in ``slot``.
+
+        Failure-isolated: any exception from the request's OWN processing
+        (prefill, first-token callback, injected ``serving-admit`` poison)
+        fails the request and leaves the slot free.  Returns True when the
+        failure happened AFTER the cache insert — the caller must reset
+        the half-claimed row unless a later admit overwrites it.
+        """
+        inserted = False
+        try:
+            if self._chaos is not None:
+                from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import ChaosFault
+
+                self._chaos.raise_if_fired("serving-admit", ChaosFault)
+            padded = np.full((1, req.bucket), self.pad_id, np.int32)
+            padded[0, : req.tokens.size] = req.tokens
+            row_cache, first_tok = self._prefill_and_pick(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
+            self.cache = self._insert(
+                self.cache, row_cache, jnp.asarray(slot, jnp.int32))
+            inserted = True
+            first = int(first_tok[0])
+            req.admit_t = now
+            req.generated.append(first)
+            req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
+            req.status = "running"
+            self._notify(req, first)
+        except Exception as e:
+            self._fail(req, e, self.clock())
+            return inserted
         self._slot_req[slot] = req
         self._slot_tok[slot] = first
         self._tok_dev = None  # host mirror changed; re-upload before decode
         if self._done_reason(req) is not None:
             self._retire(slot, self._done_reason(req), self.clock())
+        return False
 
     def _done_reason(self, req: Request) -> str | None:
         if self.eos_id is not None and req.generated and req.generated[-1] == self.eos_id:
@@ -261,8 +344,11 @@ class InferenceEngine:
     def step(self) -> int:
         """One host-loop iteration: cancel → admit → decode → retire.
         Returns the number of REAL tokens produced this iteration."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
         t0 = self.clock()
         reset_mask = np.zeros((self.slots,), bool)
+        admitted = False
 
         # 1) deadline sweep over RUNNING rows (queued rows are swept by the
         #    scheduler at pop time)
@@ -272,51 +358,117 @@ class InferenceEngine:
                 reset_mask[slot] = True
 
         # 2) admit into free slots — freed capacity refills immediately,
-        #    which is the whole point of continuous batching
+        #    which is the whole point of continuous batching.  A failed
+        #    admission (poisoned request) frees the slot for the NEXT
+        #    queued request in the same iteration — one casualty must not
+        #    idle a slot for a whole loop turn.
+        drained = False
         for slot in range(self.slots):
-            if self._slot_req[slot] is None:
+            while not drained and self._slot_req[slot] is None:
                 req = self.scheduler.pop(self.clock())
                 if req is None:
+                    drained = True
                     break
-                self._admit(req, slot, self.clock())
-                reset_mask[slot] = False  # insert fully overwrote the row
+                needs_reset = self._admit(req, slot, self.clock())
+                if self._slot_req[slot] is not None:
+                    admitted = True
+                    reset_mask[slot] = False  # insert fully overwrote the row
+                elif needs_reset:
+                    # the casualty half-claimed the row (insert landed, then
+                    # its callback raised); zero it unless a later admit in
+                    # this same while-loop overwrites it
+                    reset_mask[slot] = True
+            if drained:
+                break
 
         # 3) one batched decode step across ALL slots (fixed shape; idle
-        #    rows decode garbage into their own rows)
+        #    rows decode garbage into their own rows).  A decode-dispatch
+        #    fault belongs to ALL slots: with a watchdog it is absorbed as
+        #    a no-progress iteration until stall_timeout_s, then in-flight
+        #    requests fail and EngineStalled raises; without one it fails
+        #    in-flight and re-raises immediately.
         produced = 0
         decoded = False
         if self.occupied > 0:
-            decoded = True
-            if self._tok_dev is None:
-                self._tok_dev = jnp.asarray(self._slot_tok)
-            self.cache, nxt_dev = self._step_and_pick(
-                self.params, self.cache, self._tok_dev, self._next_rng())
-            # one sync serves both the host inspection below and the next
-            # step's feed (the device array is reused as-is — no re-upload
-            # unless an admission rewrites the host mirror)
-            nxt = np.asarray(nxt_dev)
-            self._tok_dev = nxt_dev
-            self._slot_tok = nxt.copy()
-            now = self.clock()
-            for slot, req in enumerate(self._slot_req):
-                if req is None:
-                    continue
-                tok = int(nxt[slot])
-                req.generated.append(tok)
-                produced += 1
-                reason = self._done_reason(req)
-                if reason is not None:
-                    self._retire(slot, reason, now)
-                    reset_mask[slot] = True
+            try:
+                if self._chaos is not None:
+                    from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+                        ChaosFault,
+                    )
+
+                    self._chaos.raise_if_fired("serving-step", ChaosFault)
+                if self._tok_dev is None:
+                    self._tok_dev = jnp.asarray(self._slot_tok)
+                self.cache, nxt_dev = self._step_and_pick(
+                    self.params, self.cache, self._tok_dev, self._next_rng())
+            except Exception as e:
+                now = self.clock()
+                anchor = self._last_progress_t if self._last_progress_t is not None else t0
+                if self._last_progress_t is None:
+                    self._last_progress_t = t0
+                if self.stall_timeout_s is None:
+                    self._fail_in_flight(e, now)
+                    raise
+                if now - anchor > self.stall_timeout_s:
+                    self._fail_in_flight(e, now)
+                    raise EngineStalled(
+                        f"no token progress across {self.slots} slots within "
+                        f"{self.stall_timeout_s}s (last decode error: "
+                        f"{type(e).__name__}: {e})") from e
+                # transient: no tokens this iteration, watchdog keeps counting
+            else:
+                decoded = True
+                # one sync serves both the host inspection below and the next
+                # step's feed (the device array is reused as-is — no re-upload
+                # unless an admission rewrites the host mirror)
+                nxt = np.asarray(nxt_dev)
+                self._tok_dev = nxt_dev
+                self._slot_tok = nxt.copy()
+                now = self.clock()
+                for slot, req in enumerate(self._slot_req):
+                    if req is None:
+                        continue
+                    tok = int(nxt[slot])
+                    req.generated.append(tok)
+                    produced += 1
+                    try:
+                        self._notify(req, tok)
+                    except Exception as e:
+                        # the callback's failure is THIS request's failure
+                        self._slot_req[slot] = None
+                        self._fail(req, e, now)
+                        reset_mask[slot] = True
+                        continue
+                    reason = self._done_reason(req)
+                    if reason is not None:
+                        self._retire(slot, reason, now)
+                        reset_mask[slot] = True
 
         # 4) zero retired rows so idle cursors restart from 0 (bounded) and
         #    the next admission starts from a clean row
         if reset_mask.any():
             self.cache = self._reset(self.cache, jnp.asarray(reset_mask))
 
+        if produced > 0 or admitted or self.occupied == 0:
+            self._last_progress_t = self.clock()
         self.stats.tick(self.occupied, max(self.clock() - t0, 0.0),
                         decoded=decoded)
         return produced
+
+    def _fail_in_flight(self, exc: BaseException, now: float) -> None:
+        """Fail every running request and reset their rows — the clean-exit
+        half of the watchdog contract (the engine stays consistent for a
+        caller that catches EngineStalled)."""
+        mask = np.zeros((self.slots,), bool)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self._slot_req[slot] = None
+            self._fail(req, exc, now)
+            mask[slot] = True
+        if mask.any():
+            self.cache = self._reset(self.cache, jnp.asarray(mask))
+        self._last_progress_t = None
 
     def run(self, max_steps: int | None = None) -> list[Request]:
         """Drive :meth:`step` until every submitted request has retired
@@ -337,3 +489,54 @@ class InferenceEngine:
         if self.writer is not None and not self.has_work:
             self.stats.emit(self.writer)
         return self.completed
+
+    # ------------------------------------------------------------------
+    # graceful shutdown
+
+    def drain(self, max_steps: int | None = None) -> list[Request]:
+        """Graceful shutdown, phase 1: serve every request already accepted
+        (queued + in-flight) to retirement, admitting NOTHING new —
+        :meth:`submit` raises from the moment drain starts.  Returns the
+        completed list; call :meth:`close` afterwards to release the
+        engine."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        self._draining = True
+        return self.run(max_steps=max_steps)
+
+    def close(self) -> None:
+        """Graceful shutdown, phase 2 (or an immediate one): cancel every
+        queued and in-flight request (terminal ``cancelled``, partial
+        output kept), emit the stats record, and refuse all further
+        submit/step/run/drain calls.  Idempotent."""
+        if self._closed:
+            return
+        self._draining = True
+        now = self.clock()
+        mask = np.zeros((self.slots,), bool)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self._retire(slot, "cancelled", now)
+            mask[slot] = True
+        if mask.any():
+            self.cache = self._reset(self.cache, jnp.asarray(mask))
+        while (req := self.scheduler.pop(now)) is not None:
+            req.status = "cancelled"
+            req.finish_t = now
+            self.completed.append(req)
+            self.stats.add(req)
+        for req in self.scheduler.cancelled:  # overdue-at-pop sweepings
+            self.completed.append(req)
+            self.stats.add(req)
+        self.scheduler.cancelled.clear()
+        if self.writer is not None:
+            self.stats.emit(self.writer)
+        self._closed = True
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

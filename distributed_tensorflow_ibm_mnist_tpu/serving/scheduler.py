@@ -44,7 +44,11 @@ class Request:
 
     The scheduler fills the identity/admission fields; the engine fills the
     timing/output fields as the request moves through a slot.  ``status``
-    walks queued -> running -> (done | cancelled).
+    walks queued -> running -> (done | cancelled | failed): ``failed`` is
+    the TERMINAL state of a request whose own processing raised (poisoned
+    prompt at prefill, raising user ``callback``) — the failure is
+    isolated to this request (``error`` records it) and the engine keeps
+    serving every other slot.
     """
 
     id: int
@@ -53,11 +57,15 @@ class Request:
     bucket: int                 # padded prefill length the prompt rides in
     deadline_s: float | None    # seconds from submit; None = no deadline
     submit_t: float             # scheduler clock at submit
+    callback: Callable | None = None    # per-token streaming hook:
+    #   callback(request, token) after every generated token; an exception
+    #   FAILS this request only (see engine docs)
     admit_t: float | None = None        # engine: slot admission (prefill)
     first_token_t: float | None = None  # engine: first token on host (TTFT)
     finish_t: float | None = None       # engine: retirement
     generated: list[int] = field(default_factory=list)  # engine: output
     status: str = "queued"
+    error: str | None = None            # engine: why status == "failed"
 
     @property
     def overdue_at(self) -> float:
@@ -106,9 +114,11 @@ class FIFOScheduler:
             f"({self.buckets[-1]}) — raise buckets= or shorten the prompt"
         )
 
-    def submit(self, prompt, max_new: int, deadline_s: float | None = None) -> Request:
+    def submit(self, prompt, max_new: int, deadline_s: float | None = None,
+               callback: Callable | None = None) -> Request:
         """Enqueue one request; raises :class:`QueueFull` (backpressure) or
-        ``ValueError`` (request can never be served)."""
+        ``ValueError`` (request can never be served).  ``callback`` is the
+        per-token streaming hook (see :class:`Request`)."""
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("empty prompt")
@@ -116,6 +126,8 @@ class FIFOScheduler:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if callback is not None and not callable(callback):
+            raise ValueError("callback must be callable")
         if tokens.size + max_new > self.max_len:
             raise ValueError(
                 f"prompt ({tokens.size}) + max_new ({max_new}) exceeds the "
@@ -129,7 +141,7 @@ class FIFOScheduler:
             )
         req = Request(id=next(self._ids), tokens=tokens, max_new=int(max_new),
                       bucket=bucket, deadline_s=deadline_s,
-                      submit_t=self.clock())
+                      submit_t=self.clock(), callback=callback)
         self._queue.append(req)
         return req
 

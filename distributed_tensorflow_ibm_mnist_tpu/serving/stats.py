@@ -75,6 +75,7 @@ class ServingStats:
     def summary(self) -> dict:
         done = [r for r in self.requests if r.status == "done"]
         cancelled = [r for r in self.requests if r.status == "cancelled"]
+        failed = [r for r in self.requests if r.status == "failed"]
         ttft = [r.first_token_t - r.submit_t for r in self.requests
                 if r.first_token_t is not None]
         latency = [r.finish_t - r.submit_t for r in done
@@ -90,6 +91,7 @@ class ServingStats:
             "n_requests": len(self.requests),
             "n_done": len(done),
             "n_cancelled": len(cancelled),
+            "n_failed": len(failed),
             "tokens_generated": int(n_tokens),
             "tokens_per_sec": (
                 round(n_tokens / window, 3) if window else None

@@ -1,0 +1,218 @@
+"""Deterministic, seeded fault injection — the chaos layer of ISSUE 3.
+
+The reference repo's recovery story was "K8s restarts the pod, the chief's
+Saver checkpoint resumes it" (SURVEY.md §5); the one fault this rebuild
+could inject until now was a NaN planted by hand (``utils/debug.inject_nan``).
+This module makes failure a first-class, *replayable* input: a
+:class:`FaultPlan` names WHERE faults fire (injection sites), WHAT they do
+(a per-site ``kind`` tag), and WHEN (explicit event indices and/or a seeded
+per-event probability), and a :class:`FaultInjector` executes that schedule
+deterministically — the same plan against the same program produces the
+same faults at the same events, every run, so a chaos soak that passes is a
+replayable statement, not a dice roll.
+
+Injection sites (consulted by the subsystems named in parentheses):
+
+========================  ====================================================
+``checkpoint-write``      one event per :meth:`CheckpointManager.save`
+                          (utils/checkpoint.py).  ``kind="torn"`` lets the
+                          write land then corrupts the step on disk (the
+                          crash-mid-write signature); ``kind="io"`` raises
+                          ``OSError`` before the write.
+``checkpoint-read``       one event per restore (utils/checkpoint.py);
+                          raises ``OSError`` — a transient read fault.
+``data-batch``            one event per host batch on the Trainer's stream
+                          path (core/trainer.py); raises ``OSError`` — a
+                          data-loader hiccup.
+``train-step``            one event per epoch dispatch (core/trainer.py).
+                          ``kind="nan"`` poisons one param element so the
+                          next loss is non-finite — the full divergence →
+                          detect → restore path; other kinds raise.
+``serving-admit``         one event per request admission
+                          (serving/engine.py); raises — a poisoned request
+                          whose prefill fails.
+``serving-step``          one event per batched decode dispatch
+                          (serving/engine.py); raises — a transient device
+                          fault the stall watchdog must absorb or escalate.
+``serving-callback``      one event per user-callback delivery
+                          (serving/engine.py); raises — a misbehaving
+                          streaming callback.
+========================  ====================================================
+
+Every hook is guarded by ``if <owner>._chaos is not None`` at the call
+site: a run built without an injector executes ZERO chaos instructions on
+its hot paths (asserted by ``scripts/chaos_soak.py``).
+
+Determinism contract: each site owns an event counter that increments on
+every consultation, across restarts of the component (the injector outlives
+the Trainer/engine it is wired into — ``run_with_recovery``'s
+``make_trainer`` closure passes the SAME injector to every rebuilt
+trainer).  ``at=(k,)`` therefore fires exactly once, at the k-th event
+ever, and never again after recovery replays the surrounding work.
+Probabilistic firing is a pure function of (plan seed, site, spec index,
+event index) — no hidden RNG state, so interleaving across sites cannot
+perturb the schedule.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+
+SITES = (
+    "checkpoint-write",
+    "checkpoint-read",
+    "data-batch",
+    "train-step",
+    "serving-admit",
+    "serving-step",
+    "serving-callback",
+)
+
+
+class ChaosFault(RuntimeError):
+    """An injected fault standing in for a transient infrastructure failure.
+
+    Deliberately a ``RuntimeError`` subclass so it is NOT retryable by
+    default in ``run_with_recovery`` — sites that model retryable faults
+    raise ``OSError`` instead; sites that model poison/divergence raise
+    this (or corrupt state and let the real detector fire).
+    """
+
+    def __init__(self, site: str, kind: str, event: int):
+        super().__init__(f"chaos: injected {kind!r} fault at site {site!r} event {event}")
+        self.site = site
+        self.kind = kind
+        self.event = event
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault stream at one site.
+
+    ``at`` — absolute per-site event indices that always fire.
+    ``prob`` — additionally fire on any event with this probability
+    (seeded; replayable).  ``max_fires`` caps total fires of THIS spec
+    (None = unbounded).  ``kind`` is interpreted by the site (see module
+    docstring); unknown kinds raise :class:`ChaosFault` at the site.
+    """
+
+    site: str
+    kind: str = "raise"
+    at: tuple[int, ...] = ()
+    prob: float = 0.0
+    max_fires: int | None = None
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown chaos site {self.site!r}; known: {SITES}")
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+        object.__setattr__(self, "at", tuple(int(a) for a in self.at))
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A seed plus the full fault schedule — the replayable chaos input."""
+
+    seed: int = 0
+    faults: tuple[FaultSpec, ...] = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "faults", tuple(self.faults))
+
+
+def _hash_uniform(seed: int, site: str, spec_idx: int, event: int) -> float:
+    """Uniform [0, 1) as a pure function of its arguments (blake2b-based) —
+    the stateless RNG behind ``prob`` firing, immune to call interleaving."""
+    h = hashlib.blake2b(
+        site.encode() + struct.pack("<qqq", seed, spec_idx, event), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "little") / 2.0**64
+
+
+@dataclass
+class _Fired:
+    site: str
+    event: int
+    kind: str
+    spec_idx: int
+
+
+class FaultInjector:
+    """Executes a :class:`FaultPlan`: per-site event counters + fired log.
+
+    Usage at a site (``spec`` is None on the overwhelming majority of
+    events — the schedule decides)::
+
+        if self._chaos is not None:            # zero-overhead when unwired
+            spec = self._chaos.fire("checkpoint-write")
+            if spec is not None:
+                ...  # act per spec.kind
+
+    ``fire`` consumes one event at the site whether or not anything fires,
+    which is what makes schedules replayable across recovery restarts.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._by_site: dict[str, list[tuple[int, FaultSpec]]] = {s: [] for s in SITES}
+        for idx, spec in enumerate(plan.faults):
+            self._by_site[spec.site].append((idx, spec))
+        self._events: dict[str, int] = {s: 0 for s in SITES}
+        self._spec_fires: dict[int, int] = {}
+        self.fired: list[_Fired] = []
+
+    def events(self, site: str) -> int:
+        """How many events the site has consumed so far."""
+        return self._events[site]
+
+    def fire(self, site: str) -> FaultSpec | None:
+        """Consume one event at ``site``; return the firing spec, if any.
+
+        The first matching spec (plan order) wins the event; explicit
+        ``at`` indices are checked before the seeded coin so a plan can mix
+        pinned and probabilistic faults at one site.
+        """
+        if site not in self._by_site:
+            raise ValueError(f"unknown chaos site {site!r}; known: {SITES}")
+        event = self._events[site]
+        self._events[site] = event + 1
+        for idx, spec in self._by_site[site]:
+            if spec.max_fires is not None and self._spec_fires.get(idx, 0) >= spec.max_fires:
+                continue
+            hit = event in spec.at or (
+                spec.prob > 0.0
+                and _hash_uniform(self.plan.seed, site, idx, event) < spec.prob
+            )
+            if hit:
+                self._spec_fires[idx] = self._spec_fires.get(idx, 0) + 1
+                self.fired.append(_Fired(site=site, event=event, kind=spec.kind, spec_idx=idx))
+                return spec
+        return None
+
+    def raise_if_fired(self, site: str, exc: type[Exception] = ChaosFault) -> None:
+        """Convenience for raise-only sites: fire, and raise on a hit.
+
+        ``exc`` is instantiated as ``exc(site, kind, event)`` when it is
+        :class:`ChaosFault`, else ``exc(message)`` (e.g. ``OSError``).
+        """
+        spec = self.fire(site)
+        if spec is None:
+            return
+        event = self._events[site] - 1
+        if exc is ChaosFault:
+            raise ChaosFault(site, spec.kind, event)
+        raise exc(f"chaos: injected {spec.kind!r} fault at site {site!r} event {event}")
+
+    def summary(self) -> dict:
+        """Faults injected so far, for soak reports: total + per-site."""
+        by_site: dict[str, int] = {}
+        for f in self.fired:
+            by_site[f.site] = by_site.get(f.site, 0) + 1
+        return {
+            "faults_injected": len(self.fired),
+            "by_site": by_site,
+            "events": {s: n for s, n in self._events.items() if n},
+        }

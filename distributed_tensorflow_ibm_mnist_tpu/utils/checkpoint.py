@@ -7,27 +7,149 @@ pytree — params, BatchNorm stats, optimizer state, step, RNG key — round-tri
 through orbax/tensorstore, and restore works across process/device layouts
 because the state is just a pytree that gets re-placed by the caller
 (replicated or sharded) after load.
+
+Failure hardening (ISSUE 3): every completed save gets a per-step
+INTEGRITY MANIFEST (``manifest_<step>.json`` beside the step dir: per-file
+sizes + sha256 digests and a tree digest over them) written from the
+on-disk bytes — never from device memory, so sharded saves stay
+gather-free.  A torn write (crash mid-save, injected or real) leaves
+either no manifest or bytes that no longer match one;
+:meth:`CheckpointManager.restore_latest_intact` walks newest → oldest past
+such steps instead of raising, validates what it restores (finiteness via
+``debug.all_finite``, step-number agreement with the directory), and only
+then hands the state back.  Chaos sites ``checkpoint-write`` /
+``checkpoint-read`` (utils/chaos.py) inject both failure shapes on a
+seeded schedule so the fallback is exercised by tests and the chaos soak,
+not just by production incidents.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import jax
 import orbax.checkpoint as ocp
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
 
+_MANIFEST_FMT = "manifest_{step}.json"
+_DIGEST_CHUNK = 1 << 20  # 1 MiB read chunks: bounded memory at any leaf size
+
+
+def _digest_step_dir(root: str) -> dict:
+    """Per-file {relpath: {size, sha256}} plus a tree digest over them.
+
+    Walks the ON-DISK bytes of one orbax step directory (sorted order, so
+    the tree digest is deterministic).  This is the integrity record a
+    torn/bit-rotted checkpoint cannot satisfy — and it never touches
+    device memory, so FSDP-sharded saves stay gather-free (the round-1
+    lesson test_sharded_save_no_host_gather pins).
+    """
+    files: dict[str, dict] = {}
+    tree = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            h = hashlib.sha256()
+            size = 0
+            with open(path, "rb") as f:
+                while chunk := f.read(_DIGEST_CHUNK):
+                    h.update(chunk)
+                    size += len(chunk)
+            files[rel] = {"size": size, "sha256": h.hexdigest()}
+            tree.update(f"{rel}:{files[rel]['sha256']}\n".encode())
+    return {"files": files, "tree_digest": tree.hexdigest()}
+
 
 class CheckpointManager:
     """Thin orbax wrapper: numbered step checkpoints under one directory."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3, chaos=None):
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
+        self._chaos = chaos  # utils/chaos.FaultInjector | None
+        # steps whose async save may still be in flight — their manifests
+        # are written at the next known-durable point (wait/close/explicit
+        # wait=True) so manifest emission never serializes the async
+        # pipeline (round-1 weak item 3's lesson, applied to manifests)
+        self._unmanifested: set[int] = set()
+
+    # ------------------------------------------------------------------
+    # integrity manifests
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._dir, _MANIFEST_FMT.format(step=step))
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
+
+    def _write_manifest(self, step: int) -> None:
+        root = self._step_path(step)
+        if not os.path.isdir(root):
+            return  # nothing durable to describe (e.g. stubbed orbax layer)
+        manifest = {"step": step, **_digest_step_dir(root)}
+        tmp = self._manifest_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self._manifest_path(step))  # atomic: no torn manifests
+
+    def _flush_manifests(self) -> None:
+        """Write manifests for landed steps; GC manifests of deleted steps.
+
+        Callers must only invoke this when no save is in flight (after
+        ``wait_until_finished``) — a manifest digested mid-write would
+        certify torn bytes.
+        """
+        live = set(self._mgr.all_steps())
+        for step in sorted(self._unmanifested):
+            if step in live:
+                self._write_manifest(step)
+            self._unmanifested.discard(step)
+        try:
+            for name in os.listdir(self._dir):
+                if name.startswith("manifest_") and name.endswith(".json"):
+                    try:
+                        step = int(name[len("manifest_"):-len(".json")])
+                    except ValueError:
+                        continue
+                    if step not in live:
+                        os.remove(os.path.join(self._dir, name))
+        except OSError:
+            pass  # GC is best-effort; stale manifests are harmless
+
+    def verify_step(self, step: int) -> tuple[bool, str]:
+        """Integrity verdict for one on-disk step: (ok, reason).
+
+        ``(False, "no manifest")`` is the UNKNOWN verdict — pre-manifest
+        checkpoints and crashes-before-flush both look like this, so
+        :meth:`restore_latest_intact` still attempts such steps (guarded
+        by restore-time validation) instead of condemning them.
+        """
+        root = self._step_path(step)
+        if not os.path.isdir(root):
+            return False, "missing step dir"
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            return False, "no manifest"
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False, "unreadable manifest"
+        on_disk = _digest_step_dir(root)
+        if on_disk["files"] != manifest.get("files"):
+            return False, "manifest mismatch"
+        return True, "ok"
+
+    # ------------------------------------------------------------------
+    # save / restore
 
     def save(self, state: TrainState, wait: bool = False) -> int:
         """Save at the state's current step; returns the step number.
@@ -38,8 +160,20 @@ class CheckpointManager:
         defeated FSDP's memory bound at every checkpoint).  Orbax copies
         device data out before returning, so the caller may donate the
         buffers immediately; the disk write proceeds in the background.
+        The step's integrity manifest is written once the bytes are known
+        durable (here when ``wait=True``, else at the next wait/close).
         """
         step = int(jax.device_get(state.step))
+        torn = None
+        if self._chaos is not None:
+            spec = self._chaos.fire("checkpoint-write")
+            if spec is not None:
+                if spec.kind == "torn":
+                    torn = spec  # let the write land, then corrupt it below
+                else:
+                    raise OSError(
+                        f"chaos: injected {spec.kind!r} checkpoint-write fault"
+                    )
         if step in self._mgr.all_steps():
             # Same-step overwrite (e.g. checkpoint_every landing on the final
             # epoch): this is the ONE case that must serialize with an
@@ -47,13 +181,44 @@ class CheckpointManager:
             # is still filling corrupts it.  Distinct steps stay fully async.
             self._mgr.wait_until_finished()
             self._mgr.delete(step)
+            try:
+                os.remove(self._manifest_path(step))
+            except OSError:
+                pass
         self._mgr.save(step, args=ocp.args.StandardSave(state), force=True)
+        self._unmanifested.add(step)
+        if torn is not None:
+            # the crash-mid-write signature, deterministically: the write
+            # "finished" but the step's bytes are torn and no manifest ever
+            # lands — restore_latest_intact must walk past this step
+            self._mgr.wait_until_finished()
+            self._tear_step(step)
+            self._unmanifested.discard(step)
+            return step
         if wait:
             self._mgr.wait_until_finished()
+            self._flush_manifests()
         return step
+
+    def _tear_step(self, step: int) -> None:
+        """Truncate the largest file of the step dir to half (chaos only)."""
+        root = self._step_path(step)
+        victim, vsize = None, -1
+        for dirpath, _dirs, filenames in os.walk(root):
+            for name in filenames:
+                path = os.path.join(dirpath, name)
+                size = os.path.getsize(path)
+                if size > vsize:
+                    victim, vsize = path, size
+        if victim is not None:
+            with open(victim, "r+b") as f:
+                f.truncate(vsize // 2)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
 
     def restore(self, target: TrainState, step: int | None = None) -> TrainState:
         """Restore into the structure (and placement) of ``target``.
@@ -69,6 +234,8 @@ class CheckpointManager:
             step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self._dir}")
+        if self._chaos is not None:
+            self._chaos.raise_if_fired("checkpoint-read", OSError)
 
         def to_abstract(x):
             if isinstance(x, jax.Array):
@@ -80,12 +247,69 @@ class CheckpointManager:
         abstract = jax.tree.map(to_abstract, target)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
-    def wait(self) -> None:
-        """Block until any in-flight async save lands."""
+    def restore_latest_intact(self, target: TrainState) -> TrainState:
+        """Restore the newest step that is intact AND valid, walking back.
+
+        The recovery-path restore: a torn latest step (crash mid-save, bit
+        rot, injected chaos) must cost at most the work since the previous
+        durable step, never the whole run.  Per candidate step, newest
+        first:
+
+        1. integrity — manifest digests must match the on-disk bytes;
+           "no manifest" (pre-manifest checkpoints, crash before flush) is
+           UNKNOWN, not condemned: the step is still attempted under (2);
+        2. restorability — orbax exceptions (truncated/missing files)
+           demote the step instead of propagating;
+        3. validity — the restored tree must be all-finite
+           (``debug.all_finite``: one fused device reduction, one scalar
+           readback) and its ``step`` leaf must equal the directory's step
+           number (a mislabeled/stale write fails monotonicity here).
+
+        Raises ``FileNotFoundError`` with the per-step demotion reasons
+        when no step survives.
+        """
         self._mgr.wait_until_finished()
+        self._flush_manifests()
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint found in {self._dir}")
+        tried: list[tuple[int, str]] = []
+        for step in steps:
+            ok, reason = self.verify_step(step)
+            if not ok and reason != "no manifest":
+                tried.append((step, reason))
+                continue
+            try:
+                out = self.restore(target, step=step)
+            except Exception as e:  # torn bytes surface as orbax/IO errors
+                tried.append((step, f"restore failed: {type(e).__name__}: {e}"))
+                continue
+            from distributed_tensorflow_ibm_mnist_tpu.utils.debug import all_finite
+
+            if not bool(jax.device_get(all_finite(out))):
+                tried.append((step, "restored state non-finite"))
+                continue
+            rstep = getattr(out, "step", None)
+            if rstep is not None and int(jax.device_get(rstep)) != step:
+                tried.append(
+                    (step, f"step mismatch: dir {step} != state "
+                           f"{int(jax.device_get(rstep))}")
+                )
+                continue
+            return out
+        raise FileNotFoundError(
+            f"no intact checkpoint in {self._dir}: "
+            + "; ".join(f"step {s}: {r}" for s, r in tried)
+        )
+
+    def wait(self) -> None:
+        """Block until any in-flight async save lands (and manifest it)."""
+        self._mgr.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_manifests()
         self._mgr.close()
 
 

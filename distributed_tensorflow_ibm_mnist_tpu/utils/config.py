@@ -108,7 +108,13 @@ class RunConfig:
     eval_batch_size: int = 2000
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # epochs between saves; 0 = final save only (if dir set)
-    resume: bool = False  # restore latest checkpoint from checkpoint_dir before training
+    resume: bool = False  # restore latest INTACT checkpoint from checkpoint_dir before
+    #   training (torn/corrupt newest steps are walked past — utils/checkpoint.py
+    #   restore_latest_intact; the resumed run replays the original data schedule)
+    preempt_poll_every: int = 0  # stream mode: poll the PreemptionHandler every N
+    #   steps so a SIGTERM grace window is spent checkpointing, not finishing the
+    #   epoch; 0 = epoch-boundary polling only (device mode always polls at epoch
+    #   boundaries — the epoch is one compiled dispatch there)
     metrics_path: str | None = None  # JSONL file (always also stdout unless quiet)
     quiet: bool = False  # suppress stdout metric lines (tests/benchmarks)
     profile_dir: str | None = None  # capture an XLA/TPU profile of the
