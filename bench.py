@@ -252,11 +252,14 @@ def main() -> None:
 
     # Phase 5 — the serving comparison: continuous batching (serving/
     # engine.py) vs static one-shot batching on a mixed-length synthetic
-    # request stream (ISSUE 2).  Runs scripts/bench_serving.py in a
-    # SUBPROCESS on the CPU backend so this process's accelerator backend
-    # is untouched; the block reports sustained useful tokens/sec for both
-    # legs (identical greedy output enforced), TTFT percentiles, and slot
-    # occupancy.  Skippable; never sinks the headline.
+    # request stream (ISSUE 2), plus the decode-ahead sweep (k fused
+    # decode steps per host sync, parity-gated speedup) and the
+    # prefix-cache cold/warm TTFT leg (ISSUE 5).  Runs
+    # scripts/bench_serving.py in a SUBPROCESS on the CPU backend so this
+    # process's accelerator backend is untouched; the block reports
+    # sustained useful tokens/sec for every leg (identical greedy output
+    # enforced), TTFT percentiles, and slot occupancy.  Skippable; never
+    # sinks the headline.
     serving = None
     if not os.environ.get("DTM_BENCH_SKIP_SERVING"):
         try:
@@ -269,7 +272,7 @@ def main() -> None:
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "scripts", "bench_serving.py")],
-                capture_output=True, text=True, timeout=420, env=env,
+                capture_output=True, text=True, timeout=560, env=env,
             )
             for line in out.stdout.splitlines():
                 try:

@@ -57,6 +57,11 @@ serving engine (serving/engine.py) composes on the host: ``make_prefill``
 re-expressed on the same ``_prefill_core``/``_decode_step_core`` math, so
 the fused offline episode and the serving path cannot drift apart
 (greedy parity is pinned in tests/test_serving.py).
+
+ISSUE 5 adds :func:`make_decode_window` on the same step core: ``window``
+fused decode+pick steps per dispatch (one ``lax.scan``), emitting a
+(B, window) token block — the decode-ahead primitive that lets the serving
+engine pay one host sync per k tokens instead of per token.
 """
 
 from __future__ import annotations
@@ -200,6 +205,86 @@ def make_decode_step(model, max_len: int, ragged: bool = True) -> Callable:
             model, params, cache, tok.astype(jnp.int32), max_len, ragged)
 
     return step
+
+
+def _decode_window_core(model, params, cache, tok, active, rngs,
+                        max_len: int, ragged: bool, pick, pad_id: int):
+    """``window`` fused decode+pick steps as ONE ``lax.scan`` — the
+    decode-ahead primitive shared by :func:`make_decode_window` and the
+    serving engine's windowed hot loop.
+
+    ``active`` is a (B,) bool mask FROZEN for the whole window: inactive
+    rows still decode (the batch shape is fixed) but their picked tokens
+    are replaced with ``pad_id`` before being fed back and emitted.
+    Correctness leans on the same per-row isolation the engine's idle
+    slots already use: a row's cache writes land only in its own row, so
+    an inactive row's garbage never touches an active row's prefix.
+    Returns ``(cache, (B, window) tokens, (B,) last)`` — ``last`` is the
+    final carry token, handed back so the caller can feed the next window
+    without slicing the block on the host (one extra dispatch saved)."""
+    active = jnp.asarray(active, bool)
+    pad = jnp.asarray(pad_id, jnp.int32)
+
+    def body(carry, rng):
+        cache, tok = carry
+        cache, logits = _decode_step_core(model, params, cache, tok,
+                                          max_len, ragged)
+        nxt = jnp.where(active, pick(logits, rng), pad)
+        return (cache, nxt), nxt
+
+    (cache, last), toks = jax.lax.scan(body, (cache, tok.astype(jnp.int32)),
+                                       rngs)
+    return cache, toks.T, last
+
+
+def make_decode_window(model, max_len: int, window: int, ragged: bool = True,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 0.0, pad_id: int = 0) -> Callable:
+    """Build a jitted ``win(params, cache, tok, active=None, rngs=None) ->
+    (cache, tokens, last)`` — ``window`` fused decode+pick steps per
+    dispatch (decode-ahead), the k-step sibling of :func:`make_decode_step`.
+
+    One call runs a ``lax.scan`` of ``window`` single-token steps and
+    emits a (B, window) token block: the caller pays ONE dispatch and ONE
+    host readback per k tokens instead of per token, which is the whole
+    economics of decode-ahead serving (serving/engine.py ``decode_ahead``).
+    ``active`` (B,) bool freezes which rows are live for the window —
+    inactive rows emit ``pad_id``; ``rngs`` is (window, ...) PRNG keys,
+    one per step (required when ``temperature > 0``, ignored for greedy).
+    Greedy windows are token-identical to ``window`` sequential
+    :func:`make_decode_step` calls (pinned in tests/test_decode_ahead.py);
+    sampled windows consume keys in scan order, so parity holds only for
+    the same key schedule.
+    """
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if temperature == 0.0 and (top_k or top_p):
+        raise ValueError(
+            "top_k/top_p filter a SAMPLING distribution; set temperature > 0")
+
+    def pick(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+    @jax.jit
+    def win(params, cache, tok, active=None, rngs=None):
+        b = tok.shape[0]
+        if active is None:
+            active = jnp.ones((b,), bool)
+        if rngs is None:
+            if temperature != 0.0:
+                raise ValueError(
+                    "temperature > 0 samples from the model — pass rngs= "
+                    "((window, ...) keys, one per step)")
+            rngs = jnp.zeros((window, 2), jnp.uint32)  # greedy: unused
+        return _decode_window_core(model, params, cache, tok, active, rngs,
+                                   max_len, ragged, pick, pad_id)
+
+    return win
 
 
 def init_cache(model, params, batch: int, max_len: int):

@@ -451,7 +451,10 @@ class Trainer:
             self.train_images = np.ascontiguousarray(data["train_images"])
             self.train_labels = np.ascontiguousarray(data["train_labels"], np.int32)
             if self.dp > 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
                 from distributed_tensorflow_ibm_mnist_tpu.parallel.data_parallel import (
+                    AXIS,
                     make_dp_chunk_runner,
                     make_dp_train_step,
                 )
@@ -465,6 +468,19 @@ class Trainer:
                     self.model, self.tx, self.mesh, img_ndim=img_ndim,
                     sharded_update=self._dp_sharded, state=state, **step_kw
                 )
+                # H2D placement for _run_epoch_stream: device_put against
+                # the step/chunk runners' in_specs (batch split over 'data',
+                # chunk axis replicated) so host batches land PRE-SHARDED
+                # instead of default-device-placed and re-laid-out
+                tail = [None] * (img_ndim - 1)
+                self._step_shardings = {
+                    "image": NamedSharding(self.mesh, P(AXIS, *tail)),
+                    "label": NamedSharding(self.mesh, P(AXIS)),
+                }
+                self._chunk_shardings = {
+                    "image": NamedSharding(self.mesh, P(None, AXIS, *tail)),
+                    "label": NamedSharding(self.mesh, P(None, AXIS)),
+                }
             else:
                 from distributed_tensorflow_ibm_mnist_tpu.core.steps import (
                     make_chunk_runner,
@@ -477,6 +493,9 @@ class Trainer:
                 self._train_chunk = jax.jit(
                     make_chunk_runner(self.model, self.tx, **step_kw), donate_argnums=(0,)
                 )
+                # dp=1: plain device_put (single device, no layout to pin)
+                self._step_shardings = None
+                self._chunk_shardings = None
         elif self._gspmd:
             # DP x TP (x SP) under GSPMD: Megatron specs on dense stacks
             # (replicated when tp=1), ring-attention islands when sp>1, dataset
@@ -862,17 +881,25 @@ class Trainer:
         steps.  Batches are shipped in chunks of ``stream_chunk`` — ONE
         host->device transfer per chunk, then a compiled scan over its steps —
         so per-step transfer latency (brutal on tunnelled/remote devices) is
-        amortized ``stream_chunk``-fold.  Metrics stay device-side until epoch
-        end so the dispatch pipeline never blocks on a host readback.
+        amortized ``stream_chunk``-fold.  Transfers go through
+        ``jax.device_put`` against the dp batch sharding (bare
+        ``jnp.asarray`` paid default-device placement plus a relayout under
+        dp>1) and are DOUBLE-BUFFERED one chunk ahead: chunk i+1's H2D is
+        dispatched before chunk i's compute is awaited, so the transfer
+        this path is bound by (PERFORMANCE.md §Input modes: ~13k img/s
+        H2D-bound) overlaps the scan instead of serializing with it.
+        Metrics stay device-side until epoch end so the dispatch pipeline
+        never blocks on a host readback.
 
         ``preemption`` with ``config.preempt_poll_every > 0`` is polled at
-        step granularity (every poll boundary the flushed-step counter
+        step granularity (every poll boundary the computed-step counter
         crosses): a SIGTERM mid-epoch stops the epoch at the next boundary
         with the steps run so far, so the grace window is spent
         checkpointing, not finishing an epoch that may not fit in it
         (fit() sees ``triggered`` at the epoch boundary and does the
-        checkpoint-and-exit).  Unrun prefetched batches are dropped — the
-        resumed run replays them (state.step records exactly what ran).
+        checkpoint-and-exit).  Unrun prefetched batches — including a
+        staged-but-uncomputed chunk — are dropped; the resumed run replays
+        them (state.step records exactly what ran).
         """
         from distributed_tensorflow_ibm_mnist_tpu.data.native import Prefetcher
 
@@ -889,27 +916,39 @@ class Trainer:
         pending_labs: list[np.ndarray] = []
         steps_done = 0
         next_poll = poll
+        staged = None  # device-resident chunk whose compute hasn't run yet
 
-        def flush(state):
-            nonlocal steps_done
-            k = len(pending_imgs)
-            if k == chunk and chunk > 1:
-                batches = {
-                    "image": jnp.asarray(np.stack(pending_imgs)),
-                    "label": jnp.asarray(np.stack(pending_labs)),
-                }
-                state, m = self._train_chunk(state, batches)  # scan over k steps
-                ms.append(m)
-            else:
-                # epoch-end remainder (k < chunk): drain through the per-step
-                # program instead of compiling a second k-step scan shape
-                for img, lab in zip(pending_imgs, pending_labs):
-                    batch = {"image": jnp.asarray(img), "label": jnp.asarray(lab)}
-                    state, m = self._train_step(state, batch)
-                    ms.append(m)
-            steps_done += k
+        def stage():
+            # ship ONE assembled chunk host->device, pre-sharded; the
+            # transfer is async under JAX's dispatch, which is what the
+            # one-chunk-ahead staging exploits
+            batch = {
+                "image": np.stack(pending_imgs),
+                "label": np.stack(pending_labs),
+            }
             pending_imgs.clear()
             pending_labs.clear()
+            if self._chunk_shardings is not None:
+                return jax.device_put(batch, self._chunk_shardings)
+            return jax.device_put(batch)
+
+        def run_chunk(state, batches):
+            nonlocal steps_done
+            state, m = self._train_chunk(state, batches)  # scan over k steps
+            ms.append(m)
+            steps_done += chunk
+            return state
+
+        def run_step(state, img, lab):
+            nonlocal steps_done
+            batch = {"image": img, "label": lab}
+            if self._step_shardings is not None:
+                batch = jax.device_put(batch, self._step_shardings)
+            else:
+                batch = jax.device_put(batch)
+            state, m = self._train_step(state, batch)
+            ms.append(m)
+            steps_done += 1
             return state
 
         stopped = False
@@ -920,17 +959,33 @@ class Trainer:
             for img, lab in pf:
                 if self._chaos is not None:
                     self._chaos.raise_if_fired("data-batch", OSError)
-                pending_imgs.append(img)
-                pending_labs.append(lab)
-                if len(pending_imgs) == chunk:
-                    state = flush(state)
-                    if poll and preemption is not None and steps_done >= next_poll:
-                        next_poll = steps_done + poll
-                        if preemption.triggered:
-                            stopped = True
-                            break
+                if chunk == 1:
+                    state = run_step(state, img, lab)
+                else:
+                    pending_imgs.append(img)
+                    pending_labs.append(lab)
+                    if len(pending_imgs) == chunk:
+                        # double buffer: dispatch chunk i+1's H2D, THEN run
+                        # chunk i's compute — the new transfer overlaps it
+                        nxt = stage()
+                        if staged is not None:
+                            state = run_chunk(state, staged)
+                        staged = nxt
+                if poll and preemption is not None and steps_done >= next_poll:
+                    next_poll = steps_done + poll
+                    if preemption.triggered:
+                        stopped = True
+                        break
         if not stopped:
-            state = flush(state)
+            if staged is not None:
+                state = run_chunk(state, staged)
+                staged = None
+            # epoch-end remainder (< chunk): drain through the per-step
+            # program instead of compiling a second k-step scan shape
+            for img, lab in zip(pending_imgs, pending_labs):
+                state = run_step(state, img, lab)
+            pending_imgs.clear()
+            pending_labs.clear()
         # per-chunk metrics are (k,)-stacked; per-step ones are scalars
         flat = {
             k: jnp.concatenate([jnp.atleast_1d(m[k]) for m in ms]) for k in ms[0]

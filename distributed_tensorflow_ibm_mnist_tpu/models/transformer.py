@@ -333,7 +333,17 @@ class TransformerBlock(nn.Module):
             cache_v.value = jax.lax.dynamic_update_slice(
                 cache_v.value, v_st, (0, idx0, 0, 0))
             q_pos = (idx0 + jnp.arange(s))[None]  # (1, S) broadcasts over B
-        idx_var.value = idx + s
+        # saturate the cursor at max_len: decode-ahead windows (serving
+        # engine decode_ahead=k) legitimately run a retiring row up to k-1
+        # steps past its budget before the host sees the EOS/budget stop,
+        # so a full-budget row (prompt + max_new == max_len) may decode
+        # past the cache end.  dynamic_update_slice already clamps the
+        # WRITE start; clamping the cursor too keeps RoPE offsets and mask
+        # positions bounded for those garbage steps (the row is reset at
+        # retirement — wasted FLOPs, never corruption).  A no-op for every
+        # well-behaved row: prompt + max_new <= max_len is the admission
+        # contract.
+        idx_var.value = jnp.minimum(idx + s, max_len)
 
         kc, vc = cache_k.value, cache_v.value
         ksc = scale_k.value if quant else None

@@ -7,10 +7,14 @@ Mesh-TensorFlow separation of device program from execution driver
 (PAPERS.md), applied to serving.
 
 * :class:`~.engine.InferenceEngine` — the slot-multiplexed host loop
+  (``decode_ahead=k`` batches k fused decode steps per host sync — ISSUE 5)
 * :class:`~.scheduler.FIFOScheduler` / :class:`~.scheduler.Request` —
   bounded FIFO admission with prompt-length bucketing and deadlines
+* :class:`~.prefix_cache.PrefixCache` — content-addressed byte-bounded LRU
+  of prefill results; repeated prompt prefixes skip prefill entirely
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
-  slot occupancy, emitted through :class:`~..utils.metrics.MetricWriter`
+  slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
+  emitted through :class:`~..utils.metrics.MetricWriter`
 
 See docs/SERVING.md for the architecture and knobs.
 """
@@ -19,6 +23,7 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.engine import (
     EngineStalled,
     InferenceEngine,
 )
+from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     FIFOScheduler,
     QueueFull,
@@ -30,6 +35,7 @@ __all__ = [
     "EngineStalled",
     "InferenceEngine",
     "FIFOScheduler",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "ServingStats",

@@ -11,25 +11,57 @@ variable-length multiplexing into a host-side driver loop.
 Device side (compiled once each, resident for the engine's lifetime):
 
 * ``len(buckets)`` prefill programs (core/generate.py ``make_prefill`` at
-  B=1 per padded bucket length),
-* ONE batched single-step decode across all ``slots`` rows
-  (``make_decode_step``, ragged — every slot owns an independent cursor),
+  B=1 per padded bucket length) — the bucket set comes from the scheduler
+  (one source of truth; an engine-level ``buckets=`` that disagrees with a
+  caller-supplied scheduler is rejected at construction),
+* ONE batched decode-ahead WINDOW across all ``slots`` rows
+  (``_decode_window_core``: a ``lax.scan`` of ``decode_ahead`` fused
+  decode+pick steps, ragged — every slot owns an independent cursor),
 * a slot insert (``dynamic_update_slice`` of a prefilled row into the
   (slots, max_len) cache — the slot index is traced, so one compile) and a
   per-slot reset (models/transformer.py ``reset_cache_slots``).
 
 Host loop (:meth:`InferenceEngine.step`): cancel overdue rows → admit
 queued requests into free slots (prefill at the request's bucket, pick its
-first token) → one batched decode step across ALL slots → retire rows on
-EOS / budget, zeroing their cache rows — freed slots refill on the very
+first token) → ONE windowed decode dispatch across ALL slots → retire rows
+on EOS / budget, zeroing their cache rows — freed slots refill on the very
 next iteration, so no request ever waits on another request's completion.
 Idle slots decode garbage into their own rows in lockstep (cache writes
 are per-row; the batch shape is fixed) — wasted FLOPs on an un-full
 engine, never corruption.
 
+Decode-ahead (ISSUE 5, ``decode_ahead=k``): each dispatch runs k fused
+decode+pick steps in-graph against a per-slot active mask FROZEN for the
+window, emitting a (slots, k) token block the host reads back ONCE — the
+per-token host sync and dispatch tax docs/PERFORMANCE.md §Serving measured
+drop ~k×.  Retirement conditions (EOS, budget) are still judged on the
+host, so a row that stops mid-window decodes up to k−1 garbage steps past
+its stop before the host sees it; those tokens are masked off the output
+(never appended, never delivered) and the row's ≤k−1 overrun writes land
+only in its own row (models/transformer.py clamps the cursor at max_len) —
+the same wasted-FLOPs-never-corruption contract idle slots already have.
+Greedy windows are token-identical for every k (a slot's tokens depend
+only on its own cache row and previous token); sampled runs stay
+self-deterministic per (rng, k) but consume keys in a k-dependent order.
+
+Two more host-loop latencies hide behind the window (ISSUE 5):
+
+* **Prefix cache** (``prefix_cache_bytes=``, serving/prefix_cache.py) — a
+  byte-bounded LRU keyed by blake2b over the (bucket, prompt) pair; a hit
+  reuses the stored prefill row + first token and skips the prefill
+  dispatch entirely.  Greedy-only by construction.
+* **Prefill overlap** — after dispatching a window and BEFORE blocking on
+  its readback, the engine pops the next queued request and dispatches its
+  bucketed B=1 prefill, so prefill compute overlaps the in-flight window
+  instead of stalling every slot.  The prefilled request parks in a
+  pending queue (bounded by ``slots``) and lands in the next free slot;
+  a pending request whose deadline lapses before landing is cancelled at
+  landing time (the prefill was the overlap gamble's stake).
+
 Greedy decode through this loop is token-for-token identical to
-``make_generator`` (both run the same ``_prefill_core``/
-``_decode_step_core`` math; pinned in tests/test_serving.py).
+``make_generator`` for every ``decode_ahead`` (both run the same
+``_prefill_core``/``_decode_step_core`` math; pinned in
+tests/test_serving.py and tests/test_decode_ahead.py).
 
 Failure hardening (ISSUE 3): failures are isolated at the blast radius
 they actually have.  A fault belonging to ONE request — its prefill
@@ -46,12 +78,16 @@ decode fault fails in-flight requests and re-raises immediately.
 ``close()`` (cancel queued + in-flight, emit stats, refuse further use)
 give supervisors graceful-shutdown semantics.  Chaos sites
 ``serving-admit`` / ``serving-step`` / ``serving-callback``
-(utils/chaos.py) inject all three failure shapes on a seeded schedule.
+(utils/chaos.py) inject all three failure shapes on a seeded schedule;
+per-site event indices are unchanged by decode-ahead and overlap (one
+``serving-admit`` event per admission attempt in FIFO order, one
+``serving-step`` event per window dispatch).
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Callable
 
 import jax
@@ -59,12 +95,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
+    _decode_window_core,
     _filter_logits,
     init_cache,
-    make_decode_step,
     make_prefill,
 )
 from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_slots
+from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import FIFOScheduler, Request
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
@@ -82,9 +119,14 @@ class InferenceEngine:
 
     ``slots`` is the resident decode batch (B); ``max_len`` the per-slot
     KV-cache length.  ``scheduler`` defaults to a :class:`FIFOScheduler`
-    whose buckets must fit ``max_len``.  Sampling knobs mirror
-    ``make_generator`` (greedy at ``temperature=0``; ``rng`` required
-    otherwise — per-step keys are split from it).
+    built from ``buckets=`` (or the stock bucket ladder); pass both a
+    scheduler AND ``buckets=`` and they must agree — the scheduler's
+    buckets are the compiled prefill shapes.  ``decode_ahead=k`` runs k
+    fused decode steps per dispatch/readback (greedy output is
+    k-invariant; see the module docs for the waste trade).
+    ``prefix_cache_bytes`` arms the prompt prefix cache (greedy only).
+    Sampling knobs mirror ``make_generator`` (greedy at ``temperature=0``;
+    ``rng`` required otherwise — per-step keys are split from it).
 
     Usage::
 
@@ -100,6 +142,9 @@ class InferenceEngine:
 
     def __init__(self, model, params, *, slots: int, max_len: int,
                  scheduler: FIFOScheduler | None = None,
+                 buckets: tuple[int, ...] | None = None,
+                 decode_ahead: int = 1,
+                 prefix_cache_bytes: int = 0,
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, writer: MetricWriter | None = None,
@@ -116,6 +161,10 @@ class InferenceEngine:
             raise ValueError(
                 f"max_len must be >= 2 (one prompt token + one generated), "
                 f"got {max_len}")
+        if decode_ahead < 1:
+            raise ValueError(
+                f"decode_ahead must be >= 1 (1 = one decode step per host "
+                f"sync, the classic loop), got {decode_ahead}")
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
                 f"eos_id and pad_id must differ (both {eos_id}): idle slots "
@@ -126,27 +175,53 @@ class InferenceEngine:
         if temperature != 0.0 and rng is None:
             raise ValueError(
                 "temperature > 0 samples from the model — pass rng=")
+        if prefix_cache_bytes < 0:
+            raise ValueError(
+                f"prefix_cache_bytes must be >= 0 (0 disables the cache), "
+                f"got {prefix_cache_bytes}")
+        if prefix_cache_bytes > 0 and temperature != 0.0:
+            raise ValueError(
+                "the prefix cache replays a stored GREEDY first token — "
+                "wiring it to a sampling engine (temperature > 0) would "
+                "silently freeze what should be a fresh sample; disable one")
         self.model = model
         self.params = params
         self.slots = slots
         self.max_len = max_len
+        self.decode_ahead = int(decode_ahead)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
         self.clock = clock
         # `is None`, NOT `or`: FIFOScheduler defines __len__, so an EMPTY
         # custom scheduler is falsy and `scheduler or default` would
         # silently discard it (with its buckets/bounds/clock)
-        self.scheduler = scheduler if scheduler is not None else FIFOScheduler(
-            max_len=max_len,
-            buckets=tuple(b for b in (16, 32, 64, 128) if b <= max_len) or (max_len,),
-            clock=clock)
+        if scheduler is None:
+            scheduler = FIFOScheduler(
+                max_len=max_len,
+                buckets=buckets if buckets is not None else
+                tuple(b for b in (16, 32, 64, 128) if b <= max_len) or (max_len,),
+                clock=clock)
+        elif buckets is not None:
+            # the compiled prefill shapes are derived from the SCHEDULER's
+            # buckets (one source of truth) — an engine-level buckets= that
+            # disagrees is the drift bug this check exists to catch, not a
+            # preference to silently resolve
+            want = tuple(sorted(set(int(b) for b in buckets)))
+            if want != scheduler.buckets:
+                raise ValueError(
+                    f"engine buckets= {want} != scheduler buckets "
+                    f"{scheduler.buckets} — the prefill programs compile at "
+                    "the scheduler's shapes, so a mismatch would admit "
+                    "prompts the engine never compiled for")
+        self.scheduler = scheduler
         if self.scheduler.max_len != max_len:
             raise ValueError(
                 f"scheduler.max_len ({self.scheduler.max_len}) != engine "
                 f"max_len ({max_len}) — admission would pass requests the "
                 "cache cannot hold")
+        self.buckets = self.scheduler.buckets
         self.writer = writer
-        self.stats = ServingStats(slots)
+        self.stats = ServingStats(slots, decode_ahead=self.decode_ahead)
 
         # --- compiled device programs (all resident, all fixed-shape) ---
         # The engine's slot cache is DONATED through every program that
@@ -157,7 +232,6 @@ class InferenceEngine:
         # touches the donated buffer again; the PUBLIC make_decode_step
         # stays undonated (callers own their caches).
         self._prefill = make_prefill(model, max_len)     # per-bucket shapes
-        self._decode = make_decode_step(model, max_len, ragged=True)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._reset = jax.jit(reset_cache_slots, donate_argnums=(0,))
 
@@ -167,15 +241,21 @@ class InferenceEngine:
             logits = _filter_logits(logits / temperature, top_k, top_p)
             return jax.random.categorical(rng, logits).astype(jnp.int32)
 
-        def _step_and_pick(params, cache, tok, rng):
-            # decode + token pick fused into ONE dispatch: the host loop
-            # pays per-iteration dispatch latency on every decode step, so
-            # halving the calls matters exactly where the engine competes
-            # with the fused one-shot episode (jit-of-jit traces through)
-            cache, logits = self._decode(params, cache, tok)
-            return cache, _pick(logits, rng)
+        pad_id_ = self.pad_id
 
-        self._step_and_pick = jax.jit(_step_and_pick, donate_argnums=(1,))
+        def _window_impl(params, cache, tok, active, rngs):
+            # decode_ahead fused decode+pick steps as ONE dispatch
+            # (core/generate.py _decode_window_core): the host loop pays
+            # per-iteration dispatch latency and ONE blocking readback per
+            # WINDOW instead of per token — at decode_ahead=1 this is
+            # exactly the old fused step+pick (a scan of length 1), so the
+            # classic loop and the windowed loop are the same program
+            # family, not two code paths that can drift
+            return _decode_window_core(
+                model, params, cache, tok, active, rngs, max_len,
+                True, _pick, pad_id_)
+
+        self._window = jax.jit(_window_impl, donate_argnums=(1,))
 
         def _prefill_and_pick(params, prompt, lens, rng):
             cache, last = self._prefill(params, prompt, lens)
@@ -184,12 +264,23 @@ class InferenceEngine:
         self._prefill_and_pick = jax.jit(_prefill_and_pick)
         self._greedy = temperature == 0.0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # greedy windows never read their keys: reuse ONE broadcast key
+        # block forever instead of dispatching a split per window
+        self._greedy_rngs = jnp.broadcast_to(
+            self._rng, (self.decode_ahead,) + self._rng.shape)
 
         # --- mutable engine state ---
         self.cache = init_cache(model, params, slots, max_len)
         self._slot_req: list[Request | None] = [None] * slots
         self._slot_tok = np.full((slots,), self.pad_id, np.int32)
         self._tok_dev = None  # device copy of _slot_tok; None = stale
+        self._active_dev = None  # device (slots,) bool mask; None = stale
+        # prefill-overlap parking lot: (req, (row_cache, first_tok, hit))
+        # tuples prefilled against an in-flight window, awaiting a slot
+        self._pending: deque[tuple] = deque()
+        self._prefix = (
+            PrefixCache(prefix_cache_bytes) if prefix_cache_bytes > 0
+            else None)
         self.completed: list[Request] = []
         # --- failure isolation / shutdown state ---
         self.stall_timeout_s = stall_timeout_s
@@ -256,7 +347,8 @@ class InferenceEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.occupied > 0 or len(self.scheduler) > 0
+        return (self.occupied > 0 or len(self.scheduler) > 0
+                or len(self._pending) > 0)
 
     def _next_rng(self):
         # greedy decode never reads the key — skip the split's dispatch
@@ -266,6 +358,15 @@ class InferenceEngine:
         self._rng, key = jax.random.split(self._rng)
         return key
 
+    def _window_rngs(self):
+        """(decode_ahead, ...) per-step keys for one window — the cached
+        broadcast block for greedy (never read), a fresh split otherwise."""
+        if self._greedy:
+            return self._greedy_rngs
+        keys = jax.random.split(self._rng, self.decode_ahead + 1)
+        self._rng = keys[0]
+        return keys[1:]
+
     def _retire(self, slot: int, status: str, now: float) -> None:
         # the freed slot's stale token keeps being fed to the decode step
         # (its output is ignored and its cache row is reset), so _slot_tok
@@ -274,6 +375,7 @@ class InferenceEngine:
         req.status = status
         req.finish_t = now
         self._slot_req[slot] = None
+        self._active_dev = None  # occupancy changed; next window re-freezes
         self.completed.append(req)
         self.stats.add(req)
 
@@ -295,30 +397,59 @@ class InferenceEngine:
         if req.callback is not None:
             req.callback(req, tok)
 
-    def _admit(self, req: Request, slot: int, now: float) -> bool:
-        """Prefill ``req`` at its bucket shape and land it in ``slot``.
+    def _prefill_request(self, req: Request):
+        """The per-request half of admission: one ``serving-admit`` chaos
+        event, a prefix-cache lookup, and (on a miss) the bucketed B=1
+        prefill dispatch.  Returns ``(row_cache, first_token, cache_hit)``;
+        exceptions are the REQUEST's failure and propagate to the caller
+        (inline admit or overlap dispatch), which fails it in isolation.
+        The chaos event fires once per admission attempt, hit or miss, so
+        per-site event indices are independent of the prefix cache and of
+        WHEN (inline vs overlapped) the prefill ran."""
+        if self._chaos is not None:
+            from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import ChaosFault
+
+            self._chaos.raise_if_fired("serving-admit", ChaosFault)
+        if self._prefix is not None:
+            hit = self._prefix.get(req.prefix_key)
+            self.stats.prefix(hit is not None)
+            if hit is not None:
+                return hit[0], hit[1], True
+        padded = np.full((1, req.bucket), self.pad_id, np.int32)
+        padded[0, : req.tokens.size] = req.tokens
+        row_cache, first_tok = self._prefill_and_pick(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
+        return row_cache, first_tok, False
+
+    def _admit(self, req: Request, slot: int, now: float,
+               prefilled: tuple | None = None) -> bool:
+        """Prefill ``req`` at its bucket shape and land it in ``slot``
+        (``prefilled`` carries an overlap-dispatched prefill to land
+        instead of prefilling inline).
 
         Failure-isolated: any exception from the request's OWN processing
         (prefill, first-token callback, injected ``serving-admit`` poison)
         fails the request and leaves the slot free.  Returns True when the
-        failure happened AFTER the cache insert — the caller must reset
-        the half-claimed row unless a later admit overwrites it.
+        slot's cache row needs a reset the caller must perform unless a
+        later admit overwrites it: a failure AFTER the insert landed, or a
+        request that retired at admission (its prefilled row would
+        otherwise linger under an idle slot).
         """
         inserted = False
         try:
-            if self._chaos is not None:
-                from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import ChaosFault
-
-                self._chaos.raise_if_fired("serving-admit", ChaosFault)
-            padded = np.full((1, req.bucket), self.pad_id, np.int32)
-            padded[0, : req.tokens.size] = req.tokens
-            row_cache, first_tok = self._prefill_and_pick(
-                self.params, jnp.asarray(padded),
-                jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
+            if prefilled is None:
+                prefilled = self._prefill_request(req)
+            row_cache, first_tok, cache_hit = prefilled
             self.cache = self._insert(
                 self.cache, row_cache, jnp.asarray(slot, jnp.int32))
             inserted = True
-            first = int(first_tok[0])
+            # a cache hit stored the host int; a fresh prefill syncs here
+            first = first_tok if isinstance(first_tok, int) else int(first_tok[0])
+            if self._prefix is not None and not cache_hit:
+                # insert does not donate row_cache, so the row stays valid
+                # to replay for every later identical (bucket, prompt)
+                self._prefix.put(req.prefix_key, row_cache, first)
             req.admit_t = now
             req.generated.append(first)
             req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
@@ -330,8 +461,10 @@ class InferenceEngine:
         self._slot_req[slot] = req
         self._slot_tok[slot] = first
         self._tok_dev = None  # host mirror changed; re-upload before decode
+        self._active_dev = None
         if self._done_reason(req) is not None:
             self._retire(slot, self._done_reason(req), self.clock())
+            return True  # the landed row belongs to no live request now
         return False
 
     def _done_reason(self, req: Request) -> str | None:
@@ -341,66 +474,118 @@ class InferenceEngine:
             return "done"
         return None
 
+    def _admit_free_slots(self, reset_mask) -> bool:
+        """Fill free slots: overlap-prefilled pendings first (they were
+        popped earlier, so FIFO order is preserved), then fresh scheduler
+        pops.  A failed admission (poisoned request) frees the slot for
+        the NEXT request in the same iteration — one casualty must not
+        idle a slot for a whole loop turn.  Returns True when anything
+        landed (watchdog progress)."""
+        admitted = False
+        for slot in range(self.slots):
+            while self._slot_req[slot] is None:
+                if self._pending:
+                    req, prefilled = self._pending.popleft()
+                    now = self.clock()
+                    if now > req.overdue_at:
+                        # the overlap gamble lost: prefilled, then the
+                        # deadline lapsed before a slot freed — cancel
+                        # without landing (the prefill is sunk cost)
+                        req.status = "cancelled"
+                        req.finish_t = now
+                        self.completed.append(req)
+                        self.stats.add(req)
+                        continue
+                    needs_reset = self._admit(req, slot, now,
+                                              prefilled=prefilled)
+                else:
+                    req = self.scheduler.pop(self.clock())
+                    if req is None:
+                        return admitted
+                    needs_reset = self._admit(req, slot, self.clock())
+                if self._slot_req[slot] is not None:
+                    admitted = True
+                    reset_mask[slot] = False  # insert fully overwrote the row
+                elif needs_reset:
+                    # the row was claimed but belongs to no live request
+                    # (post-insert failure, or retired at admission); zero
+                    # it unless a later admit in this loop overwrites it
+                    reset_mask[slot] = True
+        return admitted
+
+    def _overlap_prefill(self) -> None:
+        """Dispatch the NEXT queued request's bucketed prefill while a
+        decode window is still in flight — the prefill's compute hides
+        behind the window instead of stalling every resident slot at the
+        next admission.  At most one dispatch per window (matching the
+        at-most-slots admission rate) and at most ``slots`` parked
+        pendings; a failure here is the request's own (isolated), exactly
+        as if it had failed at inline admission."""
+        if len(self._pending) >= self.slots:
+            return
+        req = self.scheduler.pop(self.clock())
+        if req is None:
+            return
+        try:
+            self._pending.append((req, self._prefill_request(req)))
+        except Exception as e:
+            self._fail(req, e, self.clock())
+
     def step(self) -> int:
-        """One host-loop iteration: cancel → admit → decode → retire.
-        Returns the number of REAL tokens produced this iteration."""
+        """One host-loop iteration: cancel → admit → decode window →
+        retire.  Returns the number of REAL tokens produced this
+        iteration (window tokens past a row's EOS/budget are discarded,
+        never counted)."""
         if self._closed:
             raise RuntimeError("engine is closed")
         t0 = self.clock()
         reset_mask = np.zeros((self.slots,), bool)
-        admitted = False
 
         # 1) deadline sweep over RUNNING rows (queued rows are swept by the
-        #    scheduler at pop time)
+        #    scheduler at pop time; overlap-prefilled pendings at landing)
         for slot, req in enumerate(self._slot_req):
             if req is not None and t0 > req.overdue_at:
                 self._retire(slot, "cancelled", t0)
                 reset_mask[slot] = True
 
         # 2) admit into free slots — freed capacity refills immediately,
-        #    which is the whole point of continuous batching.  A failed
-        #    admission (poisoned request) frees the slot for the NEXT
-        #    queued request in the same iteration — one casualty must not
-        #    idle a slot for a whole loop turn.
-        drained = False
-        for slot in range(self.slots):
-            while not drained and self._slot_req[slot] is None:
-                req = self.scheduler.pop(self.clock())
-                if req is None:
-                    drained = True
-                    break
-                needs_reset = self._admit(req, slot, self.clock())
-                if self._slot_req[slot] is not None:
-                    admitted = True
-                    reset_mask[slot] = False  # insert fully overwrote the row
-                elif needs_reset:
-                    # the casualty half-claimed the row (insert landed, then
-                    # its callback raised); zero it unless a later admit in
-                    # this same while-loop overwrites it
-                    reset_mask[slot] = True
-            if drained:
-                break
+        #    which is the whole point of continuous batching
+        admitted = self._admit_free_slots(reset_mask)
 
-        # 3) one batched decode step across ALL slots (fixed shape; idle
-        #    rows decode garbage into their own rows).  A decode-dispatch
-        #    fault belongs to ALL slots: with a watchdog it is absorbed as
-        #    a no-progress iteration until stall_timeout_s, then in-flight
-        #    requests fail and EngineStalled raises; without one it fails
-        #    in-flight and re-raises immediately.
+        # 3) ONE windowed decode dispatch across ALL slots (fixed shape;
+        #    idle rows decode garbage into their own rows).  The active
+        #    mask is FROZEN for the window: rows retiring mid-window keep
+        #    decoding up to decode_ahead-1 garbage steps the host masks
+        #    off below.  A decode-dispatch fault belongs to ALL slots:
+        #    with a watchdog it is absorbed as a no-progress iteration
+        #    until stall_timeout_s, then in-flight requests fail and
+        #    EngineStalled raises; without one it fails in-flight and
+        #    re-raises immediately.
         produced = 0
         decoded = False
-        if self.occupied > 0:
+        occupied_at_dispatch = self.occupied
+        if occupied_at_dispatch > 0:
+            k = self.decode_ahead
             try:
                 if self._chaos is not None:
                     from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
                         ChaosFault,
                     )
 
+                    # one chaos event per WINDOW dispatch (not per fused
+                    # step): the event index is the dispatch count, which
+                    # keeps seeded plans stable across decode_ahead
                     self._chaos.raise_if_fired("serving-step", ChaosFault)
                 if self._tok_dev is None:
                     self._tok_dev = jnp.asarray(self._slot_tok)
-                self.cache, nxt_dev = self._step_and_pick(
-                    self.params, self.cache, self._tok_dev, self._next_rng())
+                if self._active_dev is None:
+                    self._active_dev = jnp.asarray(
+                        np.array([r is not None for r in self._slot_req]))
+                t_disp = self.clock()
+                self.cache, blk_dev, last_dev = self._window(
+                    self.params, self.cache, self._tok_dev,
+                    self._active_dev, self._window_rngs())
+                dispatch_s = self.clock() - t_disp
             except Exception as e:
                 now = self.clock()
                 anchor = self._last_progress_t if self._last_progress_t is not None else t0
@@ -418,31 +603,50 @@ class InferenceEngine:
                 # transient: no tokens this iteration, watchdog keeps counting
             else:
                 decoded = True
-                # one sync serves both the host inspection below and the next
-                # step's feed (the device array is reused as-is — no re-upload
-                # unless an admission rewrites the host mirror)
-                nxt = np.asarray(nxt_dev)
-                self._tok_dev = nxt_dev
-                self._slot_tok = nxt.copy()
+                # the window is in flight (async dispatch): spend the wait
+                # prefilling the next queued request instead of blocking
+                self._overlap_prefill()
+                # ONE blocking host sync per window: the (slots, k) block
+                # serves the host inspection below, and `last` (the final
+                # carry token) feeds the next window without a host slice
+                t_rb = self.clock()
+                blk = np.asarray(blk_dev)
+                readback_s = self.clock() - t_rb
+                self._tok_dev = last_dev
+                self._slot_tok = blk[:, -1].copy()
                 now = self.clock()
+                waste = 0
                 for slot, req in enumerate(self._slot_req):
                     if req is None:
                         continue
-                    tok = int(nxt[slot])
-                    req.generated.append(tok)
-                    produced += 1
-                    try:
-                        self._notify(req, tok)
-                    except Exception as e:
-                        # the callback's failure is THIS request's failure
-                        self._slot_req[slot] = None
-                        self._fail(req, e, now)
-                        reset_mask[slot] = True
-                        continue
-                    reason = self._done_reason(req)
-                    if reason is not None:
-                        self._retire(slot, reason, now)
-                        reset_mask[slot] = True
+                    stopped_at = None
+                    for j in range(k):
+                        tok = int(blk[slot, j])
+                        req.generated.append(tok)
+                        produced += 1
+                        try:
+                            self._notify(req, tok)
+                        except Exception as e:
+                            # the callback's failure is THIS request's
+                            # failure; its remaining window tokens die with it
+                            self._slot_req[slot] = None
+                            self._active_dev = None
+                            self._fail(req, e, now)
+                            reset_mask[slot] = True
+                            stopped_at = j
+                            break
+                        reason = self._done_reason(req)
+                        if reason is not None:
+                            # EOS/budget mid-window: keep tokens up to and
+                            # including the stop, discard the ≤k-1 overrun
+                            self._retire(slot, reason, now)
+                            reset_mask[slot] = True
+                            stopped_at = j
+                            break
+                    if stopped_at is not None:
+                        waste += k - 1 - stopped_at
+                self.stats.window(dispatch_s, readback_s,
+                                  steps=occupied_at_dispatch * k, waste=waste)
 
         # 4) zero retired rows so idle cursors restart from 0 (bounded) and
         #    the next admission starts from a clean row
@@ -468,6 +672,7 @@ class InferenceEngine:
             mask[slot] = True
         if mask.any():
             self.cache = self._reset(self.cache, jnp.asarray(mask))
+        self._active_dev = None
         self._last_progress_t = None
 
     def run(self, max_steps: int | None = None) -> list[Request]:
@@ -521,6 +726,12 @@ class InferenceEngine:
             mask[slot] = True
         if mask.any():
             self.cache = self._reset(self.cache, jnp.asarray(mask))
+        for req, _prefilled in self._pending:  # overlap-prefilled, unlanded
+            req.status = "cancelled"
+            req.finish_t = now
+            self.completed.append(req)
+            self.stats.add(req)
+        self._pending.clear()
         while (req := self.scheduler.pop(now)) is not None:
             req.status = "cancelled"
             req.finish_t = now

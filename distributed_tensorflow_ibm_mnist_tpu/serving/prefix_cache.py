@@ -1,0 +1,101 @@
+"""Content-addressed prompt prefix cache for the serving engine.
+
+ROADMAP-scale traffic repeats itself: system prompts, few-shot preambles,
+retry storms — the same token prefix prefilled again and again.  Prefill
+is the one per-request compile-shaped dispatch the engine cannot batch
+away (a B=1 bucket program that stalls every resident slot while it
+runs), so a repeated prefix is pure redundant work.  This module is the
+memoization layer: the engine keys each admission by a blake2b digest of
+its BUCKET-granular prompt (the padded shape is part of the identity —
+the same tokens in a different bucket produce a different cache row
+layout downstream) and, on a hit, reuses the stored prefill cache row and
+first greedy token, skipping the prefill dispatch entirely.
+
+Two honest scope limits, by construction:
+
+* **Whole-prompt granularity** — an entry matches only a byte-identical
+  (bucket, prompt) pair.  Partial-prefix reuse (split a prompt, reuse the
+  shared head) would need per-position cache surgery; the dominant
+  real-world case (identical system prompts / repeated requests) is
+  whole-prefix anyway.
+* **Greedy only** — the stored first token was argmax-picked; replaying
+  it under ``temperature > 0`` would silently freeze what should be a
+  fresh sample.  The engine refuses to wire a prefix cache to a sampling
+  configuration at construction.
+
+Eviction is byte-bounded LRU (``max_bytes`` over the stored cache rows'
+``nbytes``), not entry-counted — one long-bucket row can weigh hundreds
+of short ones, and the budget the operator actually has is device memory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+
+def prefix_key(bucket: int, tokens) -> str:
+    """Content address of a bucket-granular prompt prefix: blake2b over
+    the bucket id + the raw int32 token bytes.  The bucket participates
+    because it IS part of the prefill identity — the padded prefill shape
+    determines the stored row's layout and pad positions."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(int(bucket).to_bytes(8, "little"))
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.hexdigest()
+
+
+class PrefixCache:
+    """Byte-bounded LRU of prefill results keyed by :func:`prefix_key`.
+
+    Values are ``(row_cache, first_token)``: the B=1 prefill cache pytree
+    (device-resident, reused read-only — the engine's slot insert copies
+    it into the slot cache without donating it) and the host-side first
+    greedy token.  ``get`` counts hits/misses for the stats record.
+    """
+
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError(
+                f"max_bytes must be > 0 (omit the cache to disable it), "
+                f"got {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        # key -> (row_cache, first_token, entry_bytes); insertion order IS
+        # recency order (move_to_end on hit)
+        self._entries: OrderedDict[str, tuple] = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: str):
+        """The (row_cache, first_token) stored under ``key``, or None."""
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return entry[0], entry[1]
+
+    def put(self, key: str, row_cache, first_token: int) -> None:
+        """Store one prefill result, evicting least-recently-used entries
+        until the byte budget holds.  An entry larger than the whole
+        budget is refused outright (caching it would just evict
+        everything and then itself next time)."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return
+        nbytes = int(sum(leaf.nbytes for leaf in jax.tree.leaves(row_cache)))
+        if nbytes > self.max_bytes:
+            return
+        self._entries[key] = (row_cache, int(first_token), nbytes)
+        self.bytes += nbytes
+        while self.bytes > self.max_bytes:
+            _, (_, _, nb) = self._entries.popitem(last=False)
+            self.bytes -= nb

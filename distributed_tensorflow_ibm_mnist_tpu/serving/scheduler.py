@@ -33,6 +33,8 @@ from typing import Callable
 
 import numpy as np
 
+from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import prefix_key
+
 
 class QueueFull(RuntimeError):
     """Bounded-queue backpressure: the caller must retry or shed load."""
@@ -66,6 +68,9 @@ class Request:
     generated: list[int] = field(default_factory=list)  # engine: output
     status: str = "queued"
     error: str | None = None            # engine: why status == "failed"
+    prefix_key: str | None = None       # blake2b content address of the
+    #   (bucket, prompt) pair — the prefix-cache lookup key
+    #   (serving/prefix_cache.py); filled by the scheduler at submit
 
     @property
     def overdue_at(self) -> float:
@@ -141,7 +146,8 @@ class FIFOScheduler:
             )
         req = Request(id=next(self._ids), tokens=tokens, max_new=int(max_new),
                       bucket=bucket, deadline_s=deadline_s,
-                      submit_t=self.clock(), callback=callback)
+                      submit_t=self.clock(), callback=callback,
+                      prefix_key=prefix_key(bucket, tokens))
         self._queue.append(req)
         return req
 

@@ -16,6 +16,16 @@ The serving analog of the trainer's metrics-of-record discipline
   request.  Static batching's head-of-line blocking shows up directly as
   occupancy lost to retired-but-still-decoding rows; the refill loop keeps
   it near 1 under load.
+* **windows** (ISSUE 5) — per decode-ahead window: dispatch time (jit call
+  until control returns, async under the hood) vs readback time (the ONE
+  blocking host sync per window), total occupied-slot steps vs waste steps
+  (post-EOS/post-budget tokens decoded inside a window and discarded on
+  the host — the bounded ≤k−1 overrun decode-ahead trades for k× fewer
+  syncs).  ``waste_frac`` is the fraction of occupied-slot decode work
+  thrown away; it rises with ``decode_ahead`` and is the number to weigh
+  against the sync savings.
+* **prefix cache** — hits/misses of the prompt prefix cache
+  (serving/prefix_cache.py); a hit skips one whole prefill dispatch.
 
 Percentiles are p50/p95/p99 over completed requests (cancelled requests
 count in TTFT if they got a first token, and in the cancel counter, not in
@@ -48,20 +58,47 @@ class ServingStats:
     (non-finite values are sanitized to null by the writer itself).
     """
 
-    def __init__(self, slots: int):
+    def __init__(self, slots: int, decode_ahead: int = 1):
         self.slots = slots
+        self.decode_ahead = decode_ahead
         self.requests: list[Request] = []
         self._occ_time = 0.0   # integral of occupied_slots * dt
         self._busy_time = 0.0  # integral of dt while the engine had work
         self._decode_steps = 0
         self._start_t: float | None = None
         self._end_t: float | None = None
+        # --- decode-ahead window accounting (ISSUE 5) ---
+        self._windows = 0
+        self._dispatch_time = 0.0  # window jit-call time (async dispatch)
+        self._readback_time = 0.0  # the blocking (slots, k) host sync
+        self._window_steps = 0     # occupied-slot decode steps dispatched
+        self._waste_steps = 0      # of those, discarded post-retirement
+        self._prefix_hits = 0
+        self._prefix_misses = 0
 
     def tick(self, occupied: int, dt: float, decoded: bool = False) -> None:
         self._occ_time += occupied * dt
         self._busy_time += dt
         if decoded:
             self._decode_steps += 1
+
+    def window(self, dispatch_s: float, readback_s: float, steps: int,
+               waste: int) -> None:
+        """One decode-ahead window: ``steps`` = occupied slots × window
+        length dispatched, ``waste`` = the subset discarded on the host
+        (tokens decoded past a row's EOS/budget inside the window)."""
+        self._windows += 1
+        self._dispatch_time += dispatch_s
+        self._readback_time += readback_s
+        self._window_steps += steps
+        self._waste_steps += waste
+
+    def prefix(self, hit: bool) -> None:
+        """One prefix-cache lookup (hit = prefill skipped entirely)."""
+        if hit:
+            self._prefix_hits += 1
+        else:
+            self._prefix_misses += 1
 
     def add(self, req: Request) -> None:
         self.requests.append(req)
@@ -101,6 +138,23 @@ class ServingStats:
             "slot_occupancy": (
                 round(self._occ_time / (self._busy_time * self.slots), 4)
                 if self._busy_time > 0 else None
+            ),
+            "decode_ahead": self.decode_ahead,
+            "n_windows": self._windows,
+            "window_dispatch_s": round(self._dispatch_time, 6),
+            "window_readback_s": round(self._readback_time, 6),
+            "window_steps": self._window_steps,
+            "window_waste_steps": self._waste_steps,
+            "window_waste_frac": (
+                round(self._waste_steps / self._window_steps, 4)
+                if self._window_steps > 0 else None
+            ),
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "prefix_hit_rate": (
+                round(self._prefix_hits
+                      / (self._prefix_hits + self._prefix_misses), 4)
+                if (self._prefix_hits + self._prefix_misses) > 0 else None
             ),
         }
         for name, xs in (("ttft_s", ttft), ("latency_s", latency)):

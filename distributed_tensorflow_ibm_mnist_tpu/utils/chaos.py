@@ -28,12 +28,17 @@ Injection sites (consulted by the subsystems named in parentheses):
                           ``kind="nan"`` poisons one param element so the
                           next loss is non-finite — the full divergence →
                           detect → restore path; other kinds raise.
-``serving-admit``         one event per request admission
-                          (serving/engine.py); raises — a poisoned request
-                          whose prefill fails.
+``serving-admit``         one event per request admission attempt, in FIFO
+                          order (serving/engine.py) — whether the prefill
+                          runs inline, overlapped behind a decode window,
+                          or is skipped by a prefix-cache hit; raises — a
+                          poisoned request whose prefill fails.
 ``serving-step``          one event per batched decode dispatch
-                          (serving/engine.py); raises — a transient device
-                          fault the stall watchdog must absorb or escalate.
+                          (serving/engine.py) — a ``decode_ahead=k``
+                          window of k fused steps counts as ONE event, so
+                          seeded plans stay stable across k; raises — a
+                          transient device fault the stall watchdog must
+                          absorb or escalate.
 ``serving-callback``      one event per user-callback delivery
                           (serving/engine.py); raises — a misbehaving
                           streaming callback.

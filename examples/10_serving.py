@@ -5,10 +5,15 @@ batch, every row waiting for the slowest row.  This example is the ONLINE
 form (ISSUE 2, serving/): requests of different prompt lengths and
 generation budgets stream through a slot-multiplexed
 :class:`~distributed_tensorflow_ibm_mnist_tpu.serving.InferenceEngine` —
-one resident compiled decode step, per-request bucketed prefill, rows
+one resident compiled decode program, per-request bucketed prefill, rows
 retiring at their own budget (or EOS, or deadline) and freed slots
 refilling immediately — with TTFT/latency percentiles, tokens/sec, and
 slot occupancy emitted as one ``serving`` JSONL record.
+
+ISSUE 5 knobs shown here: ``decode_ahead=4`` fuses 4 decode steps per
+host sync (greedy output is k-invariant; the record's ``n_windows`` /
+``window_waste_frac`` show the trade) and ``prefix_cache_bytes`` lets a
+repeated prompt skip its prefill entirely (``prefix_hits``).
 
     python examples/10_serving.py
 """
@@ -45,14 +50,17 @@ def main():
         # two shapes; the bounded queue is the backpressure surface.
         engine = InferenceEngine.from_trainer(
             trainer, slots=4, max_len=128, writer=writer,
+            decode_ahead=4, prefix_cache_bytes=64 << 20,
             scheduler=FIFOScheduler(max_len=128, buckets=(16, 32),
                                     max_queue=32))
 
         # A mixed request stream: ragged prompts, budgets from 8 to 64 —
         # under static batching every row would pay the 64.
         rng = np.random.default_rng(0)
+        repeat = np.arange(1, 9, dtype=np.int32)  # the prefix-cache bait
         for i in range(12):
-            prompt = rng.integers(0, 32, size=(int(rng.integers(4, 30)),))
+            prompt = (repeat if i % 4 == 3 else
+                      rng.integers(0, 32, size=(int(rng.integers(4, 30)),)))
             engine.submit(prompt.astype(np.int32),
                           max_new=int(rng.choice([8, 16, 64])),
                           deadline_s=30.0)
@@ -76,6 +84,9 @@ def main():
         print(f"served {s['n_done']} requests, "
               f"{s['tokens_per_sec']:.0f} tok/s sustained, "
               f"occupancy {s['slot_occupancy']:.2f}")
+        print(f"decode-ahead {s['decode_ahead']}: {s['n_windows']} windows "
+              f"(waste {s['window_waste_frac']}), prefix cache "
+              f"{s['prefix_hits']} hits / {s['prefix_misses']} misses")
 
 
 if __name__ == "__main__":

@@ -556,7 +556,7 @@ def test_engine_stall_watchdog_transient_and_fatal():
     clock = Clock()
     eng = _engine(model, params, stall_timeout_s=5.0, clock=clock)
     eng.scheduler.clock = clock
-    real = eng._step_and_pick
+    real = eng._window
     boom = {"n": 2}
 
     def flaky(*a, **k):
@@ -565,7 +565,7 @@ def test_engine_stall_watchdog_transient_and_fatal():
             raise RuntimeError("transient device fault")
         return real(*a, **k)
 
-    eng._step_and_pick = flaky
+    eng._window = flaky
     r = eng.submit([1, 2, 3], max_new=4)
     eng.run()
     assert r.status == "done" and list(r.generated) == list(fr.generated)
@@ -579,7 +579,7 @@ def test_engine_stall_watchdog_transient_and_fatal():
         clock2.t += 3.0
         raise RuntimeError("wedged")
 
-    eng2._step_and_pick = always_boom
+    eng2._window = always_boom
     r2 = eng2.submit([1, 2, 3], max_new=4)
     with pytest.raises(EngineStalled, match="no token progress"):
         eng2.run()
@@ -588,7 +588,7 @@ def test_engine_stall_watchdog_transient_and_fatal():
 
     # without a watchdog the first decode fault fails in-flight and raises
     eng3 = _engine(model, params)
-    eng3._step_and_pick = always_boom
+    eng3._window = always_boom
     r3 = eng3.submit([1, 2], max_new=3)
     with pytest.raises(RuntimeError, match="wedged"):
         eng3.run()
