@@ -131,6 +131,13 @@ def main() -> None:
         resolve_compile_cache_dir,
     )
     from distributed_tensorflow_ibm_mnist_tpu.utils.config import get_preset
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+    # ISSUE 6 compile accounting: install before ANY jit runs so every XLA
+    # compilation in THIS process is counted (the subprocess blocks report
+    # their own counts in their JSON lines).
+    compile_tracker = CompileTracker.install()
+    compile0 = compile_tracker.snapshot()
 
     # batch 1024 saturates the chip (measured on v5e: ~590k img/s steady-state;
     # larger batches gain nothing — the model is overhead/bandwidth-bound, not
@@ -406,6 +413,13 @@ def main() -> None:
         result["chaos"] = {
             k: v for k, v in chaos.items() if k != "metric"
         }
+    # compile accounting for THIS process (phases 1/2/3 — the subprocess
+    # blocks carry their own counts): cache hits don't count, so a warm
+    # persistent compile cache shows up here as a LOWER program count
+    cdelta = CompileTracker.delta(compile_tracker.snapshot(), compile0)
+    result["n_compiled_programs"] = cdelta["n_compiled_programs"]
+    result["compile_time_s"] = cdelta["compile_time_s"]
+    result["compile_by_site"] = cdelta["by_site"]
     print(json.dumps(result), flush=True)
 
 

@@ -422,7 +422,14 @@ def make_generator(
                     f"prompt_lens must be in [1, P={prompt.shape[1]}], got "
                     f"range [{lens_c.min()}, {lens_c.max()}]"
                 )
-        return _gen(params, prompt, rng, prompt_lens)
+        # compile accounting (utils/tracing): each (B, P) shape of the one-
+        # shot episode compiles a fresh program — attribute it to a site
+        # naming this generator's static config so program-family growth
+        # from generator reuse-misses is visible in bench/trace output
+        from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import compile_site
+
+        with compile_site(f"generator[L{max_len},n{max_new}]"):
+            return _gen(params, prompt, rng, prompt_lens)
 
     @functools.partial(jax.jit, static_argnames=())
     def _gen(params, prompt, rng=None, prompt_lens=None):

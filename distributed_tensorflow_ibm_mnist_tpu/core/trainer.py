@@ -117,12 +117,23 @@ class Trainer:
     """Owns the compiled functions + train state for one run."""
 
     def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None,
-                 chaos=None):
+                 chaos=None, tracer=None):
         self.config = config
         # utils/chaos.FaultInjector | None — every chaos site below guards
         # with `is not None`, so an unwired trainer runs zero chaos
         # instructions on its hot paths (asserted by scripts/chaos_soak.py)
         self._chaos = chaos
+        # utils/tracing.Tracer | None — same nil-guard contract as chaos:
+        # per-epoch dispatch/fetch spans, per-chunk H2D/dispatch spans in
+        # stream mode, checkpoint/restore events (docs/OBSERVABILITY.md)
+        self._tracer = tracer
+        # compile accounting is always on (process-global listener, zero
+        # cost between compiles): fit() reports the programs IT compiled
+        from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+        self._compile = CompileTracker.install()
+        if tracer is not None:
+            self._compile.bind(tracer)
         # the trainer OWNS the writer only when it built one itself — a
         # caller-supplied writer (bench harnesses sharing one log) must
         # survive this trainer's close()
@@ -835,6 +846,17 @@ class Trainer:
     def save_checkpoint(self, wait: bool = True) -> int | None:
         if self._ckpt is None:
             return None
+        span = (self._tracer.begin("checkpoint_save", cat="train", wait=wait)
+                if self._tracer is not None else None)
+        try:
+            return self._save_checkpoint_inner(wait)
+        finally:
+            if span is not None:
+                # wait=False: the span covers dispatching the async save,
+                # not its landing — the integrity manifest records that
+                self._tracer.end(span)
+
+    def _save_checkpoint_inner(self, wait: bool) -> int | None:
         state = self.state
         if self._dp_sharded is not None:
             # gather-on-save for the ZeRO-1 buckets: the on-disk opt arrays
@@ -868,13 +890,26 @@ class Trainer:
         # the live state is the restore target: its shardings steer orbax to
         # load each leaf directly into this run's layout (no host staging);
         # _place_state is then a no-op re-assert of the placement contract
-        if step is None:
-            restored = self._ckpt.restore_latest_intact(self.state)
-        else:
-            restored = self._ckpt.restore(self.state, step=step)
+        span = (self._tracer.begin("checkpoint_restore", cat="train",
+                                   hardened=step is None)
+                if self._tracer is not None else None)
+        try:
+            if step is None:
+                restored = self._ckpt.restore_latest_intact(self.state)
+            else:
+                restored = self._ckpt.restore(self.state, step=step)
+        except Exception as e:
+            if span is not None:
+                # the checkpoint-integrity failure event: the hardened walk
+                # exhausted every step, or the explicit step was corrupt
+                self._tracer.end(span, error=f"{type(e).__name__}: {e}")
+            raise
         self.state = self._place_state(restored)
         self._gen_params = None  # decode-params cache keyed off the old state
-        return int(jax.device_get(self.state.step))
+        step_restored = int(jax.device_get(self.state.step))
+        if span is not None:
+            self._tracer.end(span, restored_step=step_restored)
+        return step_restored
 
     def _run_epoch_stream(self, state, epoch_rng, preemption=None):
         """One epoch in stream mode: C++-prefetched host batches -> compiled
@@ -918,6 +953,8 @@ class Trainer:
         next_poll = poll
         staged = None  # device-resident chunk whose compute hasn't run yet
 
+        tracer = self._tracer  # nil-guarded in the closures below
+
         def stage():
             # ship ONE assembled chunk host->device, pre-sharded; the
             # transfer is async under JAX's dispatch, which is what the
@@ -928,13 +965,25 @@ class Trainer:
             }
             pending_imgs.clear()
             pending_labs.clear()
+            span = (tracer.begin("h2d", cat="train", steps=chunk)
+                    if tracer is not None else None)
             if self._chunk_shardings is not None:
-                return jax.device_put(batch, self._chunk_shardings)
-            return jax.device_put(batch)
+                out = jax.device_put(batch, self._chunk_shardings)
+            else:
+                out = jax.device_put(batch)
+            if span is not None:
+                tracer.end(span)  # enqueue time; the transfer itself is async
+            return out
 
         def run_chunk(state, batches):
             nonlocal steps_done
-            state, m = self._train_chunk(state, batches)  # scan over k steps
+            span = (tracer.begin("dispatch", cat="train", steps=chunk)
+                    if tracer is not None else None)
+            try:
+                state, m = self._train_chunk(state, batches)  # scan, k steps
+            finally:
+                if span is not None:
+                    tracer.end(span)
             ms.append(m)
             steps_done += chunk
             return state
@@ -942,11 +991,20 @@ class Trainer:
         def run_step(state, img, lab):
             nonlocal steps_done
             batch = {"image": img, "label": lab}
+            span = (tracer.begin("h2d", cat="train", steps=1)
+                    if tracer is not None else None)
             if self._step_shardings is not None:
                 batch = jax.device_put(batch, self._step_shardings)
             else:
                 batch = jax.device_put(batch)
-            state, m = self._train_step(state, batch)
+            if span is not None:
+                tracer.end(span)
+                span = tracer.begin("dispatch", cat="train", steps=1)
+            try:
+                state, m = self._train_step(state, batch)
+            finally:
+                if span is not None:
+                    tracer.end(span)
             ms.append(m)
             steps_done += 1
             return state
@@ -1405,6 +1463,7 @@ class Trainer:
         # (the epoch counter restarts at 0 but state.step does not).
         step0 = int(jax.device_get(self.state.step))
         t0 = time.perf_counter()
+        compile0 = self._compile.snapshot()  # fit's own program family
         epoch_times: list[float] = []
         time_to_target = None
         best_acc = 0.0
@@ -1467,13 +1526,30 @@ class Trainer:
                             raise ChaosFault(
                                 "train-step", spec.kind,
                                 self._chaos.events("train-step") - 1)
-                if self._stream:
-                    self.state, metrics = self._run_epoch_stream(
-                        self.state, epoch_rng, preemption=preemption)
-                else:
-                    self.state, metrics = self._run_epoch(
-                        self.state, self.train_images, self.train_labels, epoch_rng
-                    )
+                espan = (self._tracer.begin("epoch_dispatch", cat="train",
+                                            epoch=epoch)
+                         if self._tracer is not None else None)
+                try:
+                    with self._compile.site("train_epoch"):
+                        if self._stream:
+                            self.state, metrics = self._run_epoch_stream(
+                                self.state, epoch_rng, preemption=preemption)
+                        else:
+                            # async dispatch: this span measures enqueue, not
+                            # compute — the interval's "fetch" span below is
+                            # where the device time surfaces (the fence)
+                            self.state, metrics = self._run_epoch(
+                                self.state, self.train_images,
+                                self.train_labels, epoch_rng)
+                except BaseException as e:
+                    # a faulted epoch still closes its span — the timeline
+                    # shows WHERE the run died, and run_with_recovery's
+                    # restart instant lands on a leak-free tracer
+                    if espan is not None:
+                        self._tracer.end(espan, error=type(e).__name__)
+                    raise
+                if espan is not None:
+                    self._tracer.end(espan)
                 pending.append((epoch, metrics))
                 if prof is not None and not prof.active:
                     # fence epoch 0 (compile + run) out, then trace the rest;
@@ -1490,7 +1566,14 @@ class Trainer:
                 if not (eval_now or preempt_now or ckpt_now):
                     continue  # keep the device queue full; no host sync this epoch
 
+                fspan = (self._tracer.begin("fetch", cat="train",
+                                            interval_epochs=len(pending))
+                         if self._tracer is not None else None)
                 fetched = jax.device_get([m for _, m in pending])
+                if fspan is not None:
+                    # the fence: every dispatched epoch in the interval
+                    # completed inside this span
+                    self._tracer.end(fspan)
                 interval = time.perf_counter() - interval_t0
                 epoch_time = interval / len(pending)  # amortized over the interval
                 if first_interval_len == 0:
@@ -1543,7 +1626,13 @@ class Trainer:
                         record["moe_dropped_frac"] = round(
                             mh["moe_dropped_frac"], 6)
                     if ep == epoch and eval_now:
-                        ev = self.evaluate()
+                        vspan = (self._tracer.begin("eval", cat="train",
+                                                    epoch=ep)
+                                 if self._tracer is not None else None)
+                        with self._compile.site("eval"):
+                            ev = self.evaluate()
+                        if vspan is not None:
+                            self._tracer.end(vspan)
                         record["test_accuracy"] = ev["accuracy"]
                         record["test_loss"] = ev["loss"]
                         best_acc = max(best_acc, ev["accuracy"])
@@ -1595,6 +1684,13 @@ class Trainer:
             # global leaf sizes: layout-independent, valid at any dp/tp/sp
             "param_count": self.state.param_count(),
         }
+        # compile accounting (ISSUE 6): programs THIS fit compiled — the
+        # per-PR regression gate for the r04→r05 cold-compile watch item
+        from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+        cdelta = CompileTracker.delta(self._compile.snapshot(), compile0)
+        summary["n_compiled_programs"] = cdelta["n_compiled_programs"]
+        summary["compile_time_s"] = round(cdelta["compile_time_s"], 3)
         tokens = self._tokens_per_sec(images / steady_mean / chips) if steady_mean else None
         if tokens is not None:
             summary["tokens_per_sec_per_chip"] = tokens

@@ -14,7 +14,15 @@ Mesh-TensorFlow separation of device program from execution driver
   of prefill results; repeated prompt prefixes skip prefill entirely
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
   slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
-  emitted through :class:`~..utils.metrics.MetricWriter`
+  compile accounting (``n_compiled_programs`` — ISSUE 6), emitted through
+  :class:`~..utils.metrics.MetricWriter`
+
+Observability (ISSUE 6): pass ``tracer=`` (utils/tracing.Tracer) to the
+engine and every request records a span tree (submit → queue → admit/
+prefill or prefix hit → decode windows → retirement, with chaos faults
+attached to the requests they hit); ``tracer.export_trace(path)`` writes a
+Chrome-/Perfetto-loadable timeline and ``scripts/trace_report.py`` renders
+it as a per-phase latency table.  See docs/OBSERVABILITY.md.
 
 See docs/SERVING.md for the architecture and knobs.
 """

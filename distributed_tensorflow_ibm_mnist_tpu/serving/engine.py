@@ -105,6 +105,7 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCach
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import FIFOScheduler, Request
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
 
 
 class EngineStalled(RuntimeError):
@@ -127,6 +128,12 @@ class InferenceEngine:
     ``prefix_cache_bytes`` arms the prompt prefix cache (greedy only).
     Sampling knobs mirror ``make_generator`` (greedy at ``temperature=0``;
     ``rng`` required otherwise — per-step keys are split from it).
+    ``tracer=`` (utils/tracing.Tracer) records a span tree per request and
+    per decode window (nil-guarded — zero tracing instructions when None);
+    construct it with the same ``clock`` as the engine so span durations
+    agree with reported latencies.  Compile accounting is always on:
+    ``stats`` reports this engine's ``n_compiled_programs`` /
+    ``compile_time_s`` by site (docs/OBSERVABILITY.md).
 
     Usage::
 
@@ -150,7 +157,7 @@ class InferenceEngine:
                  rng=None, writer: MetricWriter | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  stall_timeout_s: float | None = None,
-                 chaos=None):
+                 chaos=None, tracer=None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 (None disables the watchdog), "
@@ -200,7 +207,7 @@ class InferenceEngine:
                 max_len=max_len,
                 buckets=buckets if buckets is not None else
                 tuple(b for b in (16, 32, 64, 128) if b <= max_len) or (max_len,),
-                clock=clock)
+                clock=clock, tracer=tracer)
         elif buckets is not None:
             # the compiled prefill shapes are derived from the SCHEDULER's
             # buckets (one source of truth) — an engine-level buckets= that
@@ -214,6 +221,29 @@ class InferenceEngine:
                     "the scheduler's shapes, so a mismatch would admit "
                     "prompts the engine never compiled for")
         self.scheduler = scheduler
+        # ONE tracer serves a request's whole span tree: the scheduler
+        # opens it (submit/queue), the engine continues it (admit/decode/
+        # retire) — two different tracers would strand half-open trees in
+        # each, so adopt whichever side has one and reject a conflict.
+        sched_tracer = getattr(self.scheduler, "tracer", None)
+        if tracer is None:
+            tracer = sched_tracer
+        elif sched_tracer is None:
+            self.scheduler.tracer = tracer
+        elif sched_tracer is not tracer:
+            raise ValueError(
+                "engine tracer= and scheduler.tracer are different Tracer "
+                "objects — a request's span tree would be split across two "
+                "buffers; wire ONE tracer (either side) and both will use it")
+        self._tracer = tracer  # nil-guarded at every touch, like chaos
+        # Compile accounting is always on (the listener is process-global
+        # and costs nothing between compiles): the delta between this
+        # baseline and shutdown is the engine's own program family, folded
+        # into ServingStats as n_compiled_programs / compile_time_s.
+        self._compile = CompileTracker.install()
+        self._compile0 = self._compile.snapshot()
+        if tracer is not None:
+            self._compile.bind(tracer)
         if self.scheduler.max_len != max_len:
             raise ValueError(
                 f"scheduler.max_len ({self.scheduler.max_len}) != engine "
@@ -367,7 +397,42 @@ class InferenceEngine:
         self._rng = keys[0]
         return keys[1:]
 
-    def _retire(self, slot: int, status: str, now: float) -> None:
+    # ------------------------------------------------------------------
+    # tracing bookkeeping (every helper is a no-op without a tracer —
+    # the same zero-cost-when-unwired contract as the chaos hooks)
+
+    def _tr_phase(self, req: Request, name: str, **args) -> None:
+        """Advance ``req`` to its next lifecycle phase: close the open
+        phase span (queue/admit/decode) and open ``name`` in its place,
+        parented under the request's root span."""
+        if self._tracer is None or req.trace is None:
+            return
+        t = req.trace
+        if t.get("phase") is not None:
+            self._tracer.end(t["phase"])
+        t["phase"] = self._tracer.begin(name, cat="serving", parent=t["id"],
+                                        tid=t["tid"], **args)
+
+    def _tr_instant(self, req: Request, name: str, **args) -> None:
+        """A correlated event ON this request's tree (fault injections,
+        cache hits, first token)."""
+        if self._tracer is None or req.trace is None:
+            return
+        self._tracer.instant(name, cat="serving", parent=req.trace["id"],
+                             tid=req.trace["tid"], **args)
+
+    def _tr_close(self, req: Request, **args) -> None:
+        """Terminal: close the open phase (if any) and the request root."""
+        if self._tracer is None or req.trace is None:
+            return
+        t = req.trace
+        if t.get("phase") is not None:
+            self._tracer.end(t["phase"])
+        self._tracer.end(t["id"], **args)
+        req.trace = None
+
+    def _retire(self, slot: int, status: str, now: float,
+                waste: int = 0) -> None:
         # the freed slot's stale token keeps being fed to the decode step
         # (its output is ignored and its cache row is reset), so _slot_tok
         # needs no write here — which keeps _tok_dev valid across retires
@@ -376,6 +441,8 @@ class InferenceEngine:
         req.finish_t = now
         self._slot_req[slot] = None
         self._active_dev = None  # occupancy changed; next window re-freezes
+        self._tr_close(req, status=status, slot=slot, waste_steps=waste,
+                       n_generated=len(req.generated))
         self.completed.append(req)
         self.stats.add(req)
 
@@ -384,6 +451,15 @@ class InferenceEngine:
         req.status = "failed"
         req.error = f"{type(exc).__name__}: {exc}"
         req.finish_t = now
+        if self._tracer is not None and req.trace is not None:
+            from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import ChaosFault
+
+            if isinstance(exc, ChaosFault):
+                # the injected fault lands ON the request it hit — the
+                # site's event index correlates it back to the FaultPlan
+                self._tr_instant(req, "chaos_fault", site=exc.site,
+                                 fault_kind=exc.kind, event=exc.event)
+            self._tr_close(req, status="failed", error=req.error)
         self.completed.append(req)
         self.stats.add(req)
 
@@ -414,12 +490,22 @@ class InferenceEngine:
             hit = self._prefix.get(req.prefix_key)
             self.stats.prefix(hit is not None)
             if hit is not None:
+                self._tr_instant(req, "prefix_cache_hit", bucket=req.bucket)
                 return hit[0], hit[1], True
         padded = np.full((1, req.bucket), self.pad_id, np.int32)
         padded[0, : req.tokens.size] = req.tokens
-        row_cache, first_tok = self._prefill_and_pick(
-            self.params, jnp.asarray(padded),
-            jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
+        span = (self._tracer.begin("prefill", cat="serving",
+                                   parent=req.trace["phase"] or req.trace["id"],
+                                   tid=req.trace["tid"], bucket=req.bucket)
+                if self._tracer is not None and req.trace is not None else None)
+        try:
+            with self._compile.site(f"prefill[b{req.bucket}]"):
+                row_cache, first_tok = self._prefill_and_pick(
+                    self.params, jnp.asarray(padded),
+                    jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
+        finally:
+            if span is not None:
+                self._tracer.end(span)  # a poisoned prefill still closes it
         return row_cache, first_tok, False
 
     def _admit(self, req: Request, slot: int, now: float,
@@ -437,12 +523,18 @@ class InferenceEngine:
         otherwise linger under an idle slot).
         """
         inserted = False
+        # inline admissions open their "admit" phase here; overlap-prefilled
+        # requests opened it back at pop (in _overlap_prefill), so their
+        # phase also covers the prefill and the parked wait for a slot
+        if req.trace is not None and req.trace.get("phase") is None:
+            self._tr_phase(req, "admit", slot=slot)
         try:
             if prefilled is None:
                 prefilled = self._prefill_request(req)
             row_cache, first_tok, cache_hit = prefilled
-            self.cache = self._insert(
-                self.cache, row_cache, jnp.asarray(slot, jnp.int32))
+            with self._compile.site("slot_insert"):
+                self.cache = self._insert(
+                    self.cache, row_cache, jnp.asarray(slot, jnp.int32))
             inserted = True
             # a cache hit stored the host int; a fresh prefill syncs here
             first = first_tok if isinstance(first_tok, int) else int(first_tok[0])
@@ -454,6 +546,8 @@ class InferenceEngine:
             req.generated.append(first)
             req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
             req.status = "running"
+            self._tr_instant(req, "first_token", slot=slot,
+                             cache_hit=cache_hit)
             self._notify(req, first)
         except Exception as e:
             self._fail(req, e, self.clock())
@@ -462,6 +556,7 @@ class InferenceEngine:
         self._slot_tok[slot] = first
         self._tok_dev = None  # host mirror changed; re-upload before decode
         self._active_dev = None
+        self._tr_phase(req, "decode", slot=slot)
         if self._done_reason(req) is not None:
             self._retire(slot, self._done_reason(req), self.clock())
             return True  # the landed row belongs to no live request now
@@ -493,6 +588,7 @@ class InferenceEngine:
                         # without landing (the prefill is sunk cost)
                         req.status = "cancelled"
                         req.finish_t = now
+                        self._tr_close(req, status="cancelled")
                         self.completed.append(req)
                         self.stats.add(req)
                         continue
@@ -526,6 +622,10 @@ class InferenceEngine:
         req = self.scheduler.pop(self.clock())
         if req is None:
             return
+        # the "admit" phase opens HERE — for an overlapped request it spans
+        # prefill + the parked wait for a slot, mirroring what the request
+        # actually experiences between queue exit and its first token
+        self._tr_phase(req, "admit", overlapped=True)
         try:
             self._pending.append((req, self._prefill_request(req)))
         except Exception as e:
@@ -566,6 +666,14 @@ class InferenceEngine:
         occupied_at_dispatch = self.occupied
         if occupied_at_dispatch > 0:
             k = self.decode_ahead
+            # the engine-track (tid 0) view of this window; request-track
+            # spans tell each request's story, this tells the loop's.
+            # Emitted as already-closed `complete` spans from the stats
+            # timestamps the loop takes anyway — the windowed hot path
+            # pays 3 ring pushes per window, no open-span churn and no
+            # tracer-only clock reads.
+            t_w0 = self.clock() if self._tracer is not None else 0.0
+            t_disp = None
             try:
                 if self._chaos is not None:
                     from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
@@ -582,12 +690,28 @@ class InferenceEngine:
                     self._active_dev = jnp.asarray(
                         np.array([r is not None for r in self._slot_req]))
                 t_disp = self.clock()
-                self.cache, blk_dev, last_dev = self._window(
-                    self.params, self.cache, self._tok_dev,
-                    self._active_dev, self._window_rngs())
+                with self._compile.site(f"decode_window[k{k}]"):
+                    self.cache, blk_dev, last_dev = self._window(
+                        self.params, self.cache, self._tok_dev,
+                        self._active_dev, self._window_rngs())
                 dispatch_s = self.clock() - t_disp
             except Exception as e:
                 now = self.clock()
+                if self._tracer is not None:
+                    # a decode-dispatch fault belongs to ALL slots — the
+                    # engine-track instant records it once; requests it
+                    # kills get their own chaos_fault/close via _fail
+                    self._tracer.instant(
+                        "decode_fault", cat="serving",
+                        error=f"{type(e).__name__}: {e}")
+                    wid = self._tracer.complete(
+                        "window", t_w0, now, cat="serving", k=k,
+                        occupied=occupied_at_dispatch,
+                        error=type(e).__name__)
+                    if t_disp is not None:
+                        self._tracer.complete(
+                            "dispatch", t_disp, now, cat="serving",
+                            parent=wid, error=type(e).__name__)
                 anchor = self._last_progress_t if self._last_progress_t is not None else t0
                 if self._last_progress_t is None:
                     self._last_progress_t = t0
@@ -639,7 +763,7 @@ class InferenceEngine:
                         if reason is not None:
                             # EOS/budget mid-window: keep tokens up to and
                             # including the stop, discard the ≤k-1 overrun
-                            self._retire(slot, reason, now)
+                            self._retire(slot, reason, now, waste=k - 1 - j)
                             reset_mask[slot] = True
                             stopped_at = j
                             break
@@ -647,16 +771,34 @@ class InferenceEngine:
                         waste += k - 1 - stopped_at
                 self.stats.window(dispatch_s, readback_s,
                                   steps=occupied_at_dispatch * k, waste=waste)
+                if self._tracer is not None:
+                    wid = self._tracer.complete(
+                        "window", t_w0, self.clock(), cat="serving", k=k,
+                        occupied=occupied_at_dispatch,
+                        produced=produced, waste=waste)
+                    self._tracer.complete("dispatch", t_disp,
+                                          t_disp + dispatch_s,
+                                          cat="serving", parent=wid)
+                    self._tracer.complete("readback", t_rb,
+                                          t_rb + readback_s,
+                                          cat="serving", parent=wid)
 
         # 4) zero retired rows so idle cursors restart from 0 (bounded) and
         #    the next admission starts from a clean row
         if reset_mask.any():
-            self.cache = self._reset(self.cache, jnp.asarray(reset_mask))
+            with self._compile.site("slot_reset"):
+                self.cache = self._reset(self.cache, jnp.asarray(reset_mask))
 
         if produced > 0 or admitted or self.occupied == 0:
             self._last_progress_t = self.clock()
         self.stats.tick(self.occupied, max(self.clock() - t0, 0.0),
                         decoded=decoded)
+        # counters only at their change points (admission shrinks the
+        # queue, retirement frees slots) — the tracer dedups repeats
+        # anyway, but the calls themselves are hot-loop cost
+        if self._tracer is not None and (admitted or reset_mask.any()):
+            self._tracer.counter("queue_depth", len(self.scheduler))
+            self._tracer.counter("occupied_slots", self.occupied)
         return produced
 
     def _fail_in_flight(self, exc: BaseException, now: float) -> None:
@@ -691,8 +833,11 @@ class InferenceEngine:
             self.completed.append(req)
             self.stats.add(req)
         self.scheduler.cancelled.clear()
-        if self.writer is not None and not self.has_work:
-            self.stats.emit(self.writer)
+        if not self.has_work:
+            self.stats.set_compile(CompileTracker.delta(
+                self._compile.snapshot(), self._compile0))
+            if self.writer is not None:
+                self.stats.emit(self.writer)
         return self.completed
 
     # ------------------------------------------------------------------
@@ -729,18 +874,22 @@ class InferenceEngine:
         for req, _prefilled in self._pending:  # overlap-prefilled, unlanded
             req.status = "cancelled"
             req.finish_t = now
+            self._tr_close(req, status="cancelled")
             self.completed.append(req)
             self.stats.add(req)
         self._pending.clear()
         while (req := self.scheduler.pop(now)) is not None:
             req.status = "cancelled"
             req.finish_t = now
+            self._tr_close(req, status="cancelled")
             self.completed.append(req)
             self.stats.add(req)
         for req in self.scheduler.cancelled:  # overdue-at-pop sweepings
             self.completed.append(req)
             self.stats.add(req)
         self.scheduler.cancelled.clear()
+        self.stats.set_compile(CompileTracker.delta(
+            self._compile.snapshot(), self._compile0))
         if self.writer is not None:
             self.stats.emit(self.writer)
         self._closed = True

@@ -71,6 +71,10 @@ class Request:
     prefix_key: str | None = None       # blake2b content address of the
     #   (bucket, prompt) pair — the prefix-cache lookup key
     #   (serving/prefix_cache.py); filled by the scheduler at submit
+    trace: dict | None = None           # tracing bookkeeping (utils/tracing):
+    #   {"id": request span, "tid": the request's track, "phase": the open
+    #   lifecycle-phase span (queue/admit/decode) or None}; None when no
+    #   tracer is wired — every touch is nil-guarded like the chaos hooks
 
     @property
     def overdue_at(self) -> float:
@@ -86,7 +90,8 @@ class FIFOScheduler:
     """
 
     def __init__(self, max_len: int, buckets: tuple[int, ...] = (16, 32, 64, 128),
-                 max_queue: int = 64, clock: Callable[[], float] = time.monotonic):
+                 max_queue: int = 64, clock: Callable[[], float] = time.monotonic,
+                 tracer=None):
         if not buckets:
             raise ValueError("need at least one prefill bucket")
         if max_queue < 1:
@@ -102,6 +107,13 @@ class FIFOScheduler:
             )
         self.max_queue = max_queue
         self.clock = clock
+        # utils/tracing.Tracer | None.  The scheduler owns the submit end of
+        # a request's span tree (the request root span + its queue-wait
+        # phase); the engine adopts the same tracer (engine construction
+        # enforces agreement) and owns every later phase.  Share the
+        # scheduler's clock with the tracer, or durations won't agree with
+        # the latencies computed from submit_t/finish_t.
+        self.tracer = tracer
         self._queue: deque[Request] = deque()
         self._ids = itertools.count()
         self.cancelled: list[Request] = []  # overdue-before-admission
@@ -148,6 +160,20 @@ class FIFOScheduler:
                       bucket=bucket, deadline_s=deadline_s,
                       submit_t=self.clock(), callback=callback,
                       prefix_key=prefix_key(bucket, tokens))
+        if self.tracer is not None:
+            # root span of this request's tree, on its own viewer track;
+            # "queue" is the first lifecycle phase (closed at pop, or at
+            # overdue-cancel).  Engine phases chain off the same ids.
+            tid = self.tracer.track(f"req {req.id}")
+            rid = self.tracer.begin(
+                "request", cat="serving", tid=tid, req=req.id,
+                bucket=bucket, prompt_len=int(tokens.size),
+                max_new=int(max_new))
+            req.trace = {
+                "id": rid, "tid": tid,
+                "phase": self.tracer.begin("queue", cat="serving",
+                                           parent=rid, tid=tid),
+            }
         self._queue.append(req)
         return req
 
@@ -162,7 +188,16 @@ class FIFOScheduler:
             if now > req.overdue_at:
                 req.status = "cancelled"
                 req.finish_t = now
+                if req.trace is not None and self.tracer is not None:
+                    # terminal here: close the queue phase AND the request
+                    # root (the engine never sees this request)
+                    self.tracer.end(req.trace["phase"])
+                    self.tracer.end(req.trace["id"], status="cancelled")
+                    req.trace = None
                 self.cancelled.append(req)
                 continue
+            if req.trace is not None and self.tracer is not None:
+                self.tracer.end(req.trace["phase"])  # queue wait over
+                req.trace["phase"] = None
             return req
         return None

@@ -75,6 +75,10 @@ class ServingStats:
         self._waste_steps = 0      # of those, discarded post-retirement
         self._prefix_hits = 0
         self._prefix_misses = 0
+        # --- compile accounting (ISSUE 6) --- the engine's own XLA
+        # program family: a CompileTracker snapshot DELTA from engine
+        # construction to stats emission (utils/tracing.py)
+        self._compile: dict | None = None
 
     def tick(self, occupied: int, dt: float, decoded: bool = False) -> None:
         self._occ_time += occupied * dt
@@ -99,6 +103,14 @@ class ServingStats:
             self._prefix_hits += 1
         else:
             self._prefix_misses += 1
+
+    def set_compile(self, delta: dict) -> None:
+        """Record the engine's compile accounting — a
+        ``CompileTracker.delta`` dict (``n_compiled_programs``,
+        ``compile_time_s``, ``by_site``).  The engine calls this with its
+        construction→emission snapshot delta, so the figure is THIS
+        engine's program family, not the process total."""
+        self._compile = delta
 
     def add(self, req: Request) -> None:
         self.requests.append(req)
@@ -156,6 +168,17 @@ class ServingStats:
                       / (self._prefix_hits + self._prefix_misses), 4)
                 if (self._prefix_hits + self._prefix_misses) > 0 else None
             ),
+            # compile accounting (None until set_compile — an engine that
+            # never emitted stats has no delta to report)
+            "n_compiled_programs": (
+                self._compile["n_compiled_programs"]
+                if self._compile is not None else None),
+            "compile_time_s": (
+                self._compile["compile_time_s"]
+                if self._compile is not None else None),
+            "compile_by_site": (
+                self._compile["by_site"]
+                if self._compile is not None else None),
         }
         for name, xs in (("ttft_s", ttft), ("latency_s", latency)):
             for k, v in percentiles(xs).items():

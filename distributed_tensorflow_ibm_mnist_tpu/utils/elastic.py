@@ -128,6 +128,7 @@ def run_with_recovery(
     jitter_seed: int = 0,
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
+    tracer=None,
 ) -> dict[str, Any]:
     """Run ``make_trainer().fit()`` with restart-from-checkpoint supervision.
 
@@ -151,12 +152,25 @@ def run_with_recovery(
     ``restart`` record (attempt, exception type, resume step, backoff)
     through the new trainer's MetricWriter.  Returns the final summary
     with a ``restarts`` count added.
+
+    ``tracer`` (utils/tracing.Tracer | None, nil-guarded like every other
+    hook): each restart lands as a ``restart`` instant (attempt, exception,
+    resume step, backoff) on the timeline, correlated with the trainer's
+    ``checkpoint_restore`` span — TOGETHER they are the recovery story a
+    ``restart`` JSONL record alone can't tell (what the walk skipped, how
+    long the restore took, where the replay resumed).
     """
     attempt = 0
     pending_restart: dict[str, Any] | None = None
     window: deque[float] = deque()
     while True:
         trainer = make_trainer()
+        if tracer is not None and getattr(trainer, "_tracer", None) is None:
+            # supervised trainers inherit the supervisor's tracer, so the
+            # restore/epoch spans land on the same timeline as the restart
+            # instants (a fresh trainer per attempt would otherwise trace
+            # nowhere)
+            trainer._tracer = tracer
         if attempt > 0:
             cfg = trainer.config
             if not cfg.checkpoint_dir:
@@ -186,6 +200,12 @@ def run_with_recovery(
                     resume_step=resume_step,
                     backoff_s=pending_restart["backoff_s"],
                 )
+                if tracer is not None:
+                    tracer.instant(
+                        "restart", cat="elastic", attempt=attempt,
+                        exception=pending_restart["exception"],
+                        resume_step=resume_step,
+                        backoff_s=pending_restart["backoff_s"])
                 pending_restart = None
         try:
             summary = trainer.fit(preemption=preemption)

@@ -48,6 +48,7 @@ class MetricWriter:
         self._stdout = stdout
         self._t0 = time.perf_counter()
         self._tb = None
+        self._closed = False
         if tensorboard_dir:
             try:
                 from tensorboardX import SummaryWriter
@@ -57,6 +58,13 @@ class MetricWriter:
                 self._tb = None
 
     def write(self, kind: str, step: int | None = None, **metrics: Any) -> dict[str, Any]:
+        if self._closed:
+            # fail HERE with the actual problem, not three frames deep with
+            # "ValueError: I/O operation on closed file" from the file handle
+            raise RuntimeError(
+                f"MetricWriter is closed — write({kind!r}) after close() "
+                "would lose the record; keep the writer open for the "
+                "component's lifetime or create a new one")
         record = {"kind": kind, "t": round(time.perf_counter() - self._t0, 4)}
         if step is not None:
             record["step"] = int(step)
@@ -74,6 +82,12 @@ class MetricWriter:
         return record
 
     def close(self) -> None:
+        """Release the file/TensorBoard handles.  Idempotent: a writer
+        shared across components (trainer + engine) may see close() from
+        more than one shutdown path."""
+        if self._closed:
+            return
+        self._closed = True
         if self._file:
             self._file.close()
         if self._tb:

@@ -101,8 +101,29 @@ class StepTimer:
         (never silently reports compile time as steady state)."""
         return self._times[self._warmup:]
 
-    def summary(self, items_per_step: int | None = None) -> dict[str, float]:
-        ts = np.asarray(self.times or [float("nan")])
+    def summary(self, items_per_step: int | None = None) -> dict[str, Any]:
+        """Post-warmup timing stats, always strict-JSON-safe.
+
+        Zero post-warmup samples (every step was warmup, or no steps ran)
+        yields ``None``-valued fields — NOT NaN: feeding ``[nan]`` through
+        np.percentile/mean sprays RuntimeWarnings and produces bare ``NaN``
+        tokens that break every strict JSON consumer downstream.  The same
+        sanitizer MetricWriter applies to records (metrics._sanitize)
+        guards the computed path too, so a pathological sample can never
+        leak a non-finite value either.
+        """
+        from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import _sanitize
+
+        samples = self.times
+        if not samples:
+            out: dict[str, Any] = {
+                "steps": int(len(self._times)),
+                "mean_s": None, "p50_s": None, "p90_s": None, "max_s": None,
+            }
+            if items_per_step:
+                out["items_per_sec"] = None
+            return out
+        ts = np.asarray(samples)
         out = {
             "steps": int(len(self._times)),
             "mean_s": float(ts.mean()),
@@ -112,7 +133,7 @@ class StepTimer:
         }
         if items_per_step:
             out["items_per_sec"] = float(items_per_step / ts.mean())
-        return out
+        return _sanitize(out)
 
 
 def profile_fn(fn: Callable, *args, iters: int = 10, warmup: int = 2) -> dict[str, float]:

@@ -1,0 +1,555 @@
+"""End-to-end request/step tracing with compile accounting (ISSUE 6).
+
+The observability layer both stacks were missing: MetricWriter JSONL and
+ServingStats percentiles say *that* p99 TTFT regressed or cold compile
+jumped (BENCH_r04→r05); this module records *why* — a per-request /
+per-step span tree on monotonic clocks, exportable to the Chrome/Perfetto
+trace viewer, plus per-site attribution of every XLA compilation.  The
+TensorFlow paper (1605.08695 §5) and TF-Replicator (1902.00465) both treat
+runtime tracing and per-op accounting as first-class system components;
+this is that layer for the rebuild.
+
+Three pieces:
+
+* :class:`Tracer` — a bounded ring buffer of typed events (spans with
+  parent ids, instants, counters) on one monotonic clock.  ~Zero cost when
+  unwired: every call site guards with ``if self._tracer is not None`` (the
+  exact nil-guard pattern of the chaos hooks, utils/chaos.py), so a run
+  built without a tracer executes no tracing instructions on its hot
+  paths.  ``export_trace(path)`` writes Chrome-trace-viewer /
+  Perfetto-loadable JSON (strict: non-finite numbers sanitized to null);
+  ``summary()`` folds the buffer into one strict-JSON dict.
+* :class:`CompileTracker` — process-global accounting of XLA compilations
+  via ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+  event (one firing per compiled program; cache hits don't fire), each
+  attributed to the SITE active at compile time (``with tracker.site(
+  "prefill[b32]")``).  Falls back to a count-only ``jax_log_compiles``
+  logging tap when the monitoring API is unavailable.  This is what makes
+  "number of distinct compiled programs" a tracked bench metric — the
+  r04→r05 cold-compile regression (ROADMAP item 5) becomes reproducible
+  and regression-gated per-PR.
+* :func:`validate_trace` — the schema gate for exported traces: strict
+  JSON (no NaN/Infinity tokens), every span closed, every parent id
+  resolving.  ``scripts/trace_report.py`` renders the same files into a
+  per-phase latency table.
+
+Event schema (what ``export_trace`` writes, documented in
+docs/OBSERVABILITY.md): one JSON object ``{"traceEvents": [...],
+"displayTimeUnit": "ms"}``.  Spans are ``ph: "X"`` complete events
+(``ts``/``dur`` in microseconds since the tracer epoch) carrying
+``args.id`` (unique per span) and ``args.parent`` (another span's id, or
+absent for roots); instants are ``ph: "i"`` with the same correlation
+args; counters are ``ph: "C"``.  Spans still open at export time are
+written as ``ph: "B"`` (begin-without-end) so an unclosed span is VISIBLE
+in the file — and rejected by :func:`validate_trace` — instead of
+silently dropped.  Track (``tid``) 0 is the engine/trainer host loop;
+each serving request gets its own track (named ``req <id>``), which is
+what makes a request's span tree render as one lane in the viewer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, IO
+
+from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import _sanitize
+
+
+class Tracer:
+    """Bounded ring buffer of span/instant/counter events, one clock.
+
+    ``capacity`` bounds CLOSED events (open spans live outside the ring
+    until ended, so a long-lived request can never be evicted mid-flight);
+    when full, the oldest closed event is dropped and ``dropped``
+    increments — a soak that outruns the buffer degrades to a sliding
+    window, never to unbounded memory.  ``clock`` must be monotonic and
+    SHARED with the component being traced (the engine's default
+    ``time.monotonic`` matches this default) so span durations agree with
+    the latencies the component reports.
+
+    Usage::
+
+        tracer = Tracer()
+        with tracer.span("prefill", cat="serving", bucket=32):
+            ...
+        rid = tracer.begin("request", tid=tracer.track("req 0"))
+        ...
+        tracer.end(rid, status="done")
+        tracer.export_trace("/tmp/serve.trace.json")
+
+    Not thread-safe by design: the engine/trainer host loops are single
+    threads (the same contract as the rest of their state); a lock on the
+    hot path would be cost without a customer.
+    """
+
+    def __init__(self, capacity: int = 65536,
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.t0 = clock()
+        self._events: deque[dict] = deque()  # closed events, ring-bounded
+        self._open: dict[int, dict] = {}     # span id -> event under way
+        self._ids = itertools.count(1)
+        self._tids = itertools.count(1)      # tid 0 = the host loop
+        self._track_names: dict[int, str] = {0: "host"}
+        self._last_counter: dict[tuple[str, int], float] = {}
+        self.dropped = 0
+
+    # ------------------------------------------------------------------
+    # recording
+    #
+    # Closed events are stored as flat 9-tuples, not dicts —
+    # ``(kind, id, parent, name, cat, tid, ts, dur_or_value, args)`` —
+    # because the ring push is the tracer's hot path (hundreds of events
+    # per serving rep land inside the ≤2% overhead budget) and a tuple is
+    # several times cheaper to build than a keyed dict.  ``events()``
+    # materializes the documented dict shape on demand; only the cold
+    # paths (summary/export) ever read the tuples.
+
+    def _push(self, ev: tuple) -> None:
+        if len(self._events) >= self.capacity:
+            self._events.popleft()
+            self.dropped += 1
+        self._events.append(ev)
+
+    def track(self, name: str) -> int:
+        """Allocate a new track (Chrome ``tid``) named ``name`` — one lane
+        in the viewer.  Track 0 (the host loop) always exists."""
+        tid = next(self._tids)
+        self._track_names[tid] = str(name)
+        return tid
+
+    def begin(self, name: str, cat: str = "", parent: int | None = None,
+              tid: int = 0, **args: Any) -> int:
+        """Open a span; returns its id (pass to :meth:`end`, or as
+        ``parent=`` of children).  ``args`` are correlation payload
+        (sanitized to strict JSON at export)."""
+        sid = next(self._ids)
+        # `args` is the **kwargs dict — already fresh, owned by this event
+        self._open[sid] = {
+            "type": "span", "id": sid, "parent": parent, "name": name,
+            "cat": cat, "tid": tid, "ts": self.clock() - self.t0,
+            "args": args,
+        }
+        return sid
+
+    def end(self, span_id: int, **args: Any) -> None:
+        """Close a span.  Unknown/already-closed ids are ignored (an
+        error path that double-ends must not crash the traced system)."""
+        ev = self._open.pop(span_id, None)
+        if ev is None:
+            return
+        ts = ev["ts"]
+        if args:
+            ev["args"].update(args)
+        self._push(("span", span_id, ev["parent"], ev["name"], ev["cat"],
+                    ev["tid"], ts, max(0.0, self.clock() - self.t0 - ts),
+                    ev["args"]))
+
+    def complete(self, name: str, start: float, end: float, cat: str = "",
+                 parent: int | None = None, tid: int = 0,
+                 **args: Any) -> int:
+        """Record an already-measured span from caller-supplied clock
+        readings (``start``/``end`` are values of THIS tracer's ``clock``).
+        One ring push, no open-span bookkeeping, no extra clock calls —
+        the cheap path for hot loops that already time their phases (the
+        engine's window dispatch/readback reuse their stats timestamps)."""
+        sid = next(self._ids)
+        self._push(("span", sid, parent, name, cat, tid, start - self.t0,
+                    max(0.0, end - start), args))
+        return sid
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "", parent: int | None = None,
+             tid: int = 0, **args: Any):
+        """Lexically-scoped span; yields the span id for child nesting."""
+        sid = self.begin(name, cat=cat, parent=parent, tid=tid, **args)
+        try:
+            yield sid
+        finally:
+            self.end(sid)
+
+    def instant(self, name: str, cat: str = "", parent: int | None = None,
+                tid: int = 0, **args: Any) -> int:
+        """A zero-duration correlated event (fault injections, cache hits,
+        restarts); ``parent`` attaches it to a span's tree."""
+        iid = next(self._ids)
+        self._push(("instant", iid, parent, name, cat, tid,
+                    self.clock() - self.t0, None, args))
+        return iid
+
+    def counter(self, name: str, value: float, tid: int = 0) -> None:
+        """A sampled scalar series (queue depth, occupancy, compile count).
+        Deduplicated: a sample equal to the last recorded value for this
+        (name, tid) is dropped — counters are step functions and Chrome
+        viewers hold the last value, so repeats are pure ring pressure
+        (the engine samples every host iteration; steady state is flat)."""
+        key = (name, tid)
+        if self._last_counter.get(key) == value:
+            return
+        self._last_counter[key] = value
+        self._push(("counter", None, None, name, "", tid,
+                    self.clock() - self.t0, value, None))
+
+    # ------------------------------------------------------------------
+    # reading
+
+    @property
+    def open_spans(self) -> int:
+        return len(self._open)
+
+    @staticmethod
+    def _as_dict(ev: tuple) -> dict:
+        kind, sid, parent, name, cat, tid, ts, x, args = ev
+        if kind == "span":
+            return {"type": "span", "id": sid, "parent": parent,
+                    "name": name, "cat": cat, "tid": tid, "ts": ts,
+                    "dur": x, "args": args}
+        if kind == "instant":
+            return {"type": "instant", "id": sid, "parent": parent,
+                    "name": name, "cat": cat, "tid": tid, "ts": ts,
+                    "args": args}
+        return {"type": "counter", "name": name, "tid": tid, "ts": ts,
+                "value": x}
+
+    def events(self) -> list[dict]:
+        """Closed events in record order (materialized from the internal
+        tuple ring; counters included)."""
+        return [self._as_dict(ev) for ev in self._events]
+
+    def summary(self) -> dict:
+        """Strict-JSON rollup: per-(cat, name) span counts/durations,
+        final counter values, buffer health.  Same sanitizer as
+        MetricWriter (non-finite -> null), so a diverged duration can
+        never corrupt the record it lands in."""
+        phases: dict[str, dict] = {}
+        counters: dict[str, Any] = {}
+        for kind, _sid, _parent, name, cat, _tid, _ts, x, _args in (
+                self._events):
+            if kind == "counter":
+                counters[name] = x
+                continue
+            if kind != "span":
+                continue
+            key = f"{cat}/{name}" if cat else name
+            p = phases.setdefault(
+                key, {"n": 0, "total_s": 0.0, "max_s": 0.0})
+            p["n"] += 1
+            p["total_s"] += x
+            p["max_s"] = max(p["max_s"], x)
+        for p in phases.values():
+            p["mean_s"] = p["total_s"] / p["n"] if p["n"] else None
+            p["total_s"] = round(p["total_s"], 6)
+            p["max_s"] = round(p["max_s"], 6)
+            if p["mean_s"] is not None:
+                p["mean_s"] = round(p["mean_s"], 6)
+        return _sanitize({
+            "events": len(self._events),
+            "open_spans": len(self._open),
+            "dropped": self.dropped,
+            "phases": phases,
+            "counters": counters,
+        })
+
+    # ------------------------------------------------------------------
+    # export
+
+    def export_trace(self, path_or_file: str | IO[str]) -> dict:
+        """Write the buffer as Chrome-trace-viewer / Perfetto JSON.
+
+        Strict JSON end to end: args pass through the MetricWriter
+        sanitizer and the dump refuses NaN/Infinity tokens outright.
+        Spans whose parent was evicted from the ring are kept with the
+        dangling ``parent`` DROPPED (the span is real; the broken edge is
+        not) so exported files always pass :func:`validate_trace`'s
+        parent-resolution check.  OPEN spans export as ``ph: "B"`` —
+        visibly unclosed, and rejected by the validator — because a span
+        that never ended is a finding, not something to paper over.
+        Returns ``{"events": n, "path": ...}``.
+        """
+        present = {ev[1] for ev in self._events if ev[0] == "span"}
+        present.update(self._open.keys())
+        out: list[dict] = [
+            {"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+             "args": {"name": "distributed_tensorflow_ibm_mnist_tpu"}},
+        ]
+        for tid, name in sorted(self._track_names.items()):
+            out.append({"ph": "M", "pid": 0, "tid": tid,
+                        "name": "thread_name", "args": {"name": name}})
+
+        def corr(args: dict, sid: int, parent: int | None) -> dict:
+            args = dict(args)
+            args["id"] = sid
+            if parent is not None and parent in present:
+                args["parent"] = parent
+            return _sanitize(args)
+
+        for kind, sid, parent, name, cat, tid, ts, x, args in self._events:
+            base = {"pid": 0, "tid": tid, "ts": round(ts * 1e6, 3)}
+            if kind == "span":
+                out.append({**base, "ph": "X", "name": name,
+                            "cat": cat or "trace",
+                            "dur": round(x * 1e6, 3),
+                            "args": corr(args, sid, parent)})
+            elif kind == "instant":
+                out.append({**base, "ph": "i", "s": "t", "name": name,
+                            "cat": cat or "trace",
+                            "args": corr(args, sid, parent)})
+            elif kind == "counter":
+                out.append({**base, "ph": "C", "name": name,
+                            "args": _sanitize({"value": x})})
+        for ev in self._open.values():  # unclosed: visible, not hidden
+            out.append({"pid": 0, "tid": ev["tid"], "ph": "B",
+                        "ts": round(ev["ts"] * 1e6, 3), "name": ev["name"],
+                        "cat": ev["cat"] or "trace",
+                        "args": corr(ev["args"], ev["id"], ev["parent"])})
+        doc = {"displayTimeUnit": "ms", "traceEvents": out}
+        if hasattr(path_or_file, "write"):
+            json.dump(doc, path_or_file, allow_nan=False)
+            path = getattr(path_or_file, "name", None)
+        else:
+            with open(path_or_file, "w") as f:
+                json.dump(doc, f, allow_nan=False)
+            path = path_or_file
+        return {"events": len(out), "path": path}
+
+
+def _reject_constant(s: str):
+    raise ValueError(f"non-strict JSON token {s!r} in trace file")
+
+
+def load_trace(path: str) -> dict:
+    """Parse an exported trace STRICTLY: bare ``NaN``/``Infinity`` tokens
+    (legal to Python's json, fatal to every other consumer) are errors."""
+    with open(path) as f:
+        return json.load(f, parse_constant=_reject_constant)
+
+
+def validate_trace(path: str) -> list[str]:
+    """Validate an exported trace against the documented schema.
+
+    Returns a list of problems (empty == valid):
+    * strict JSON — no NaN/Infinity anywhere in the file;
+    * a ``traceEvents`` list of objects with ``ph``/``ts``;
+    * every span closed — any ``ph: "B"`` event is an unclosed span;
+    * span ids unique, and every ``args.parent`` resolving to a span id;
+    * timestamps/durations finite and non-negative.
+    """
+    problems: list[str] = []
+    try:
+        doc = load_trace(path)
+    except (ValueError, OSError) as e:
+        return [f"unparseable: {e}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    span_ids: set[int] = set()
+    spans: list[dict] = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "ph" not in ev:
+            problems.append(f"event {i}: not an object with ph")
+            continue
+        ph = ev["ph"]
+        if ph == "B":
+            problems.append(
+                f"event {i}: unclosed span {ev.get('name')!r} (ph B)")
+            continue
+        if ph not in ("X", "i", "C", "M"):
+            problems.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"event {i}: bad ts {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i}: bad dur {dur!r}")
+            sid = (ev.get("args") or {}).get("id")
+            if sid is None:
+                problems.append(f"event {i}: span without args.id")
+            elif sid in span_ids:
+                problems.append(f"event {i}: duplicate span id {sid}")
+            else:
+                span_ids.add(sid)
+            spans.append(ev)
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") not in ("X", "i"):
+            continue
+        parent = (ev.get("args") or {}).get("parent")
+        if parent is not None and parent not in span_ids:
+            problems.append(
+                f"{ev.get('name')!r}: parent {parent} does not resolve")
+    return problems
+
+
+# ----------------------------------------------------------------------
+# compile accounting
+
+
+class CompileTracker:
+    """Process-global XLA compile accounting with per-site attribution.
+
+    ``install()`` registers ONE ``jax.monitoring`` duration listener per
+    process (listeners cannot be unregistered individually, so the tracker
+    is a singleton — everything downstream reads snapshot DELTAS, never
+    absolute counts).  Each ``/jax/core/compile/backend_compile_duration``
+    firing is one compiled XLA program: cache hits (in-process jit cache
+    or the persistent compilation cache) do not fire, which is exactly the
+    "distinct compiled programs" figure ROADMAP item 5 wants gated.
+
+    Attribution: the innermost active ``with tracker.site("label")``
+    (thread-local stack) owns compilations fired inside it; outside any
+    site they land in ``"unattributed"``.  The engine labels its program
+    family (``prefill[b<bucket>]``, ``decode_window[k<k>]``, ...), the
+    trainer its step variants — so a program-family explosion names the
+    site that grew.
+
+    Fallback: where ``jax.monitoring`` is missing the tracker taps jax's
+    ``jax_log_compiles`` logger instead — counts only (``compile_time_s``
+    stays 0.0); ``self.mode`` records which path is live ("monitoring",
+    "log_compiles", or "unavailable").
+    """
+
+    _instance: "CompileTracker | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.n = 0
+        self.time_s = 0.0
+        self.by_site: dict[str, dict[str, float]] = {}
+        self.mode = "unavailable"
+        self._tl = threading.local()
+        self._mu = threading.Lock()
+        self._tracer: Tracer | None = None
+
+    @classmethod
+    def install(cls) -> "CompileTracker":
+        """The process singleton, registering the listener on first call."""
+        with cls._lock:
+            if cls._instance is None:
+                tracker = cls()
+                tracker._register()
+                cls._instance = tracker
+            return cls._instance
+
+    def _register(self) -> None:
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                self._on_duration)
+            self.mode = "monitoring"
+            return
+        except Exception:
+            pass
+        try:  # count-only fallback: tap the jax_log_compiles logger
+            import logging
+
+            import jax
+
+            jax.config.update("jax_log_compiles", True)
+
+            tracker = self
+
+            class _Tap(logging.Handler):
+                def emit(self, record):
+                    try:
+                        if "Compiling" in record.getMessage():
+                            tracker._record(0.0)
+                    except Exception:
+                        pass
+
+            logging.getLogger("jax._src.dispatch").addHandler(_Tap())
+            logging.getLogger("jax._src.interpreters.pjit").addHandler(_Tap())
+            self.mode = "log_compiles"
+        except Exception:
+            self.mode = "unavailable"
+
+    def _on_duration(self, name: str, secs: float, **kw) -> None:
+        # one firing per compiled XLA program; everything else ignored
+        try:
+            if name == "/jax/core/compile/backend_compile_duration":
+                self._record(float(secs))
+        except Exception:
+            pass  # a broken listener must never break a compile
+
+    def _record(self, secs: float) -> None:
+        stack = getattr(self._tl, "stack", None)
+        site = stack[-1] if stack else "unattributed"
+        with self._mu:
+            self.n += 1
+            self.time_s += secs
+            s = self.by_site.setdefault(site, {"n": 0, "time_s": 0.0})
+            s["n"] += 1
+            s["time_s"] += secs
+        if self._tracer is not None:
+            self._tracer.instant(
+                "xla_compile", cat="compile", site=site,
+                compile_time_s=round(secs, 6))
+
+    @contextlib.contextmanager
+    def site(self, label: str):
+        """Attribute compilations inside the block to ``label`` (nested
+        sites: innermost wins)."""
+        stack = getattr(self._tl, "stack", None)
+        if stack is None:
+            stack = self._tl.stack = []
+        stack.append(str(label))
+        try:
+            yield
+        finally:
+            stack.pop()
+
+    def bind(self, tracer: Tracer | None) -> None:
+        """Mirror each compile as an ``xla_compile`` instant into
+        ``tracer`` (None unbinds).  One tracer at a time — the singleton
+        serves whoever wired it last."""
+        self._tracer = tracer
+
+    def snapshot(self) -> dict:
+        """Monotonic totals since install: ``{"n_compiled_programs",
+        "compile_time_s", "by_site"}`` (strict JSON; copy, not a view)."""
+        with self._mu:
+            return {
+                "n_compiled_programs": self.n,
+                "compile_time_s": round(self.time_s, 6),
+                "by_site": {
+                    k: {"n": v["n"], "time_s": round(v["time_s"], 6)}
+                    for k, v in self.by_site.items()
+                },
+            }
+
+    @staticmethod
+    def delta(after: dict, before: dict) -> dict:
+        """What compiled BETWEEN two snapshots — the per-component figure
+        every consumer (ServingStats, bench blocks) actually reports."""
+        by_site: dict[str, dict] = {}
+        b_sites = before.get("by_site", {})
+        for site, v in after.get("by_site", {}).items():
+            b = b_sites.get(site, {"n": 0, "time_s": 0.0})
+            dn = v["n"] - b["n"]
+            if dn > 0:
+                by_site[site] = {
+                    "n": dn, "time_s": round(v["time_s"] - b["time_s"], 6)}
+        return {
+            "n_compiled_programs": (
+                after["n_compiled_programs"] - before["n_compiled_programs"]),
+            "compile_time_s": round(
+                after["compile_time_s"] - before["compile_time_s"], 6),
+            "by_site": by_site,
+        }
+
+
+def compile_site(label: str):
+    """Module-level convenience: ``with compile_site("eval"): ...``
+    attributes compilations without threading the tracker through call
+    signatures.  Installs the singleton on first use."""
+    return CompileTracker.install().site(label)

@@ -15,6 +15,13 @@ host sync (greedy output is k-invariant; the record's ``n_windows`` /
 ``window_waste_frac`` show the trade) and ``prefix_cache_bytes`` lets a
 repeated prompt skip its prefill entirely (``prefix_hits``).
 
+ISSUE 6: ``tracer=`` records every request as a span tree (queue →
+admit/prefill → decode) on its own timeline track; ``export_trace``
+writes a file ``chrome://tracing`` / Perfetto loads directly, and
+``scripts/trace_report.py`` prints the per-phase latency split.  The
+stats record also carries compile accounting (``n_compiled_programs``
+by site — docs/OBSERVABILITY.md).
+
     python examples/10_serving.py
 """
 
@@ -29,6 +36,7 @@ from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
 from distributed_tensorflow_ibm_mnist_tpu.serving import FIFOScheduler, InferenceEngine, QueueFull
 from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import Tracer
 
 
 def main():
@@ -48,9 +56,10 @@ def main():
         # The engine serves the SAME clean decode model + device-resident
         # params Trainer.generate uses.  Buckets bound prefill compiles to
         # two shapes; the bounded queue is the backpressure surface.
+        tracer = Tracer()  # one clock for the engine AND its scheduler
         engine = InferenceEngine.from_trainer(
             trainer, slots=4, max_len=128, writer=writer,
-            decode_ahead=4, prefix_cache_bytes=64 << 20,
+            decode_ahead=4, prefix_cache_bytes=64 << 20, tracer=tracer,
             scheduler=FIFOScheduler(max_len=128, buckets=(16, 32),
                                     max_queue=32))
 
@@ -87,6 +96,15 @@ def main():
         print(f"decode-ahead {s['decode_ahead']}: {s['n_windows']} windows "
               f"(waste {s['window_waste_frac']}), prefix cache "
               f"{s['prefix_hits']} hits / {s['prefix_misses']} misses")
+        print(f"compiled {s['n_compiled_programs']} XLA programs "
+              f"({s['compile_time_s']}s): {s['compile_by_site']}")
+
+        # The timeline: every request above is a span tree on its own
+        # track.  Load the file in Perfetto / chrome://tracing, or run
+        #   python scripts/trace_report.py /tmp/serving.trace.json
+        out = tracer.export_trace("/tmp/serving.trace.json")
+        print(f"trace: {out['events']} events -> {out['path']} "
+              f"(open spans: {tracer.open_spans})")
 
 
 if __name__ == "__main__":

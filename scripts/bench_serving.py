@@ -39,6 +39,22 @@ Two more legs (ISSUE 5):
   (cache off) vs warm (cache on): reports the prefill-skip count and the
   TTFT delta hits buy.
 
+Two more legs (ISSUE 6, observability):
+
+* **compile_census** — one engine, buckets (16, 32), four requests in
+  sequence with a CompileTracker snapshot delta around each: repeated
+  buckets compile ZERO new XLA programs, a first-seen bucket compiles
+  exactly its prefill program — the ``n_compiled_programs`` moves when,
+  and only when, a new bucket is introduced.
+* **tracer_overhead** — the primary serving model windowed at the
+  decode-ahead leg's top ``k``, served tracer-off vs tracer-on as PAIRED
+  back-to-back reps (order alternating, GC swept first); reported
+  ``overhead_frac`` is the median within-pair ratio, which cancels the
+  host drift two independent blocks would absorb differently.  The
+  <= 2% budget is measured there, not on the dim-32 toy regime where a
+  whole decode step is ~200us of host Python and ANY per-window event
+  model breaches 2% by arithmetic (see docs/OBSERVABILITY.md §Overhead).
+
 ``DTM_BENCH_QUICK=1`` shrinks models/streams to a CI smoke of the same
 code paths (exercised by a ``slow``-marked test so harness rot is caught
 without paying the full sweep); the record carries ``"quick": true``.
@@ -287,6 +303,155 @@ def run_prefix_cache(model, params, slots: int, repeats: int) -> dict:
     }
 
 
+def run_compile_census(slots: int) -> dict:
+    """ISSUE 6 acceptance: ``n_compiled_programs`` changes when — and only
+    when — a new prefill bucket is introduced.  ONE engine (jit caches are
+    per-engine closures) with buckets (16, 32) serves four requests in
+    sequence; the CompileTracker snapshot delta around each shows
+
+    1. first bucket-16 request: the engine's cold set (prefill[b16],
+       decode_window, slot_insert, slot_reset) compiles;
+    2. second bucket-16 request: ZERO new programs (all cache hits);
+    3. first bucket-32 request: EXACTLY the new bucket's prefill program;
+    4. second bucket-32 request: zero again.
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+    tracker = CompileTracker.install()
+    max_len = 32 + SHORT_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DA_DIM,
+                      depth=DA_DEPTH, heads=DA_HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(4),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = InferenceEngine(
+        model, params, slots=slots, max_len=max_len,
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(16, 32),
+                                max_queue=8))
+    rng = np.random.default_rng(5)
+
+    def serve_one(prompt_len):
+        before = tracker.snapshot()
+        prompt = rng.integers(1, VOCAB - 1, size=(prompt_len,)).astype(np.int32)
+        eng.submit(prompt, max_new=SHORT_NEW)
+        eng.run()
+        d = CompileTracker.delta(tracker.snapshot(), before)
+        return {"n_new_programs": d["n_compiled_programs"],
+                "by_site": {k: v["n"] for k, v in d["by_site"].items()}}
+
+    legs = {
+        "bucket16_first": serve_one(8),
+        "bucket16_repeat": serve_one(10),   # same bucket, different prompt
+        "bucket32_new": serve_one(24),
+        "bucket32_repeat": serve_one(28),
+    }
+    return {
+        "legs": legs,
+        "mode": tracker.mode,
+        # the acceptance booleans bench.py's record pins: repeats compile
+        # NOTHING, and the new bucket compiles SOMETHING
+        "repeat_compiles_zero": (
+            legs["bucket16_repeat"]["n_new_programs"] == 0
+            and legs["bucket32_repeat"]["n_new_programs"] == 0),
+        "new_bucket_compiles": legs["bucket32_new"]["n_new_programs"] > 0,
+    }
+
+
+def run_tracer_overhead(slots: int, requests: int) -> dict:
+    """Tracer cost on the decode bench the budget is pinned against: the
+    serving bench's PRIMARY model (``DIM``/``DEPTH``/``HEADS`` — the
+    regime whose tokens/sec the bench headlines) at the decode-ahead
+    leg's top window size, served by a tracer-off engine vs a tracer-on
+    one, both warmed.  Target: <= 2% overhead.
+
+    Not measured on the decode-ahead study's dim-32 toy model: there a
+    whole decode step is ~200 us of host Python, so ANY per-request/
+    per-window event model is >2% by arithmetic (each recorded event
+    costs ~1-2 us; even no-op tracer calls breach the budget).  The toy
+    regime exists to stress window amortization, not to represent
+    serving; the budget is for tracing realistically-sized decode."""
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+        ServingStats,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import Tracer
+
+    max_len = BUCKET + LONG_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DIM,
+                      depth=DEPTH, heads=HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(6),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    stream = make_stream(requests, seed=8)
+    warm = make_stream(max(slots * 2, 8), seed=9)
+
+    k = DA_KS[-1]
+
+    def build(tracer):
+        eng = InferenceEngine(
+            model, params, slots=slots, max_len=max_len, tracer=tracer,
+            decode_ahead=k,
+            scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET,),
+                                    max_queue=max(len(stream), len(warm)),
+                                    tracer=tracer))
+        for p, mn in warm:
+            eng.submit(p, max_new=mn)
+        eng.run()
+        return eng
+
+    def timed(eng):
+        eng.completed.clear()
+        eng.stats = ServingStats(eng.slots, decode_ahead=eng.decode_ahead)
+        t0 = time.perf_counter()
+        for p, mn in stream:
+            eng.submit(p, max_new=mn)
+        eng.run()
+        return time.perf_counter() - t0
+
+    # a large-capacity tracer so the soak never wraps mid-measurement (ring
+    # eviction is cheap, but keep the two legs structurally identical)
+    tracer = Tracer(capacity=1 << 18)
+    eng_off, eng_on = build(None), build(tracer)
+    # The effect (~0.5 ms of tracer work) is far below this host's
+    # run-to-run noise (tens of ms runs drifting ±20% over minutes), so
+    # measure PAIRED: each rep times the two legs back-to-back (order
+    # alternating, GC swept first) and yields one on/off ratio — drift
+    # across a ~70 ms pair window cancels where two independent
+    # min-of-reps blocks would each absorb a different machine state.
+    # The reported overhead is the median pair ratio.
+    import gc
+
+    reps = 10
+    off_ts: list[float] = []
+    on_ts: list[float] = []
+    for i in range(reps):
+        pair = ((eng_off, eng_on) if i % 2 == 0 else (eng_on, eng_off))
+        for eng in pair:
+            gc.collect()
+            t = timed(eng)
+            (off_ts if eng is eng_off else on_ts).append(t)
+    ratios = sorted(b / a for a, b in zip(off_ts, on_ts))
+    mid = len(ratios) // 2
+    median_ratio = (ratios[mid] if len(ratios) % 2
+                    else (ratios[mid - 1] + ratios[mid]) / 2.0)
+    off_s, on_s = min(off_ts), min(on_ts)
+    return {
+        "n_requests": len(stream),
+        "decode_ahead": k,
+        "off_s": round(off_s, 4),
+        "on_s": round(on_s, 4),
+        "overhead_frac": round(median_ratio - 1.0, 4),
+        "target_frac": 0.02,
+        "n_trace_events": len(tracer.events()) + tracer.open_spans,
+        "dropped_events": tracer.dropped,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=32)
@@ -354,6 +519,9 @@ def main() -> None:
             args.slots, 16 if QUICK else args.requests),
         "prefix_cache": run_prefix_cache(
             model, params, args.slots, 6 if QUICK else 12),
+        "compile_census": run_compile_census(args.slots),
+        "tracer_overhead": run_tracer_overhead(
+            args.slots, 16 if QUICK else 24),
         "quick": QUICK,
         "device": str(jax.devices()[0]),
         "note": (

@@ -1,0 +1,201 @@
+"""Per-phase latency breakdown of an exported trace (utils/tracing).
+
+Reads a Chrome-trace JSON written by ``Tracer.export_trace``, rebuilds the
+span tree from the correlation args (``args.id`` / ``args.parent`` — the
+viewer-independent identity every exported span carries), and prints
+
+* a **per-phase table** — one row per ``cat/name`` span kind: count,
+  total/mean/p50/p95/max milliseconds.  This is the table the per-request
+  percentiles in ServingStats can't show: WHERE inside a request the time
+  went (queue vs prefill vs decode), and where inside a training step
+  (h2d vs dispatch vs fence);
+* a **per-request rollup** (when ``request`` root spans are present) —
+  per request: status, bucket, total latency, and the child-phase split,
+  plus the unattributed remainder (root minus sum of child phases —
+  scheduler hand-off and host-loop slack live there);
+* the **instant and counter digest** — faults, restarts, cache hits, and
+  last counter values, so a soak's timeline is summarized without a GUI.
+
+Validation runs first (``validate_trace``): a trace with unclosed spans,
+dangling parents, or non-strict JSON is reported and (with ``--strict``)
+fails the run — the same checks the tier-1 export test pins.
+
+Usage:
+    python scripts/trace_report.py TRACE.json [--json] [--strict] [--top N]
+
+``--json`` emits one machine-readable JSON line instead of tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (  # noqa: E402
+    load_trace,
+    validate_trace,
+)
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (no numpy dep)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, round(q / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[int(i)]
+
+
+def analyze(doc: dict) -> dict:
+    """Pure analysis of a loaded trace doc — also used by tests."""
+    events = doc.get("traceEvents", [])
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    counters = [e for e in events if e.get("ph") == "C"]
+
+    # --- per-phase aggregation -------------------------------------------
+    phases: dict[str, list[float]] = {}
+    by_id: dict[int, dict] = {}
+    for e in spans:
+        key = f"{e.get('cat', '')}/{e['name']}"
+        phases.setdefault(key, []).append(e.get("dur", 0) / 1e3)  # us -> ms
+        sid = (e.get("args") or {}).get("id")
+        if sid is not None:
+            by_id[sid] = e
+
+    phase_rows = []
+    for key in sorted(phases, key=lambda k: -sum(phases[k])):
+        vals = sorted(phases[key])
+        phase_rows.append({
+            "phase": key,
+            "count": len(vals),
+            "total_ms": round(sum(vals), 3),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_pct(vals, 50), 3),
+            "p95_ms": round(_pct(vals, 95), 3),
+            "max_ms": round(vals[-1], 3),
+        })
+
+    # --- per-request rollup ----------------------------------------------
+    children: dict[int, list[dict]] = {}
+    for e in spans:
+        parent = (e.get("args") or {}).get("parent")
+        if parent is not None:
+            children.setdefault(parent, []).append(e)
+
+    requests = []
+    for e in spans:
+        if e["name"] != "request":
+            continue
+        args = e.get("args") or {}
+        total_ms = e.get("dur", 0) / 1e3
+        split = {}
+        for c in children.get(args.get("id"), []):
+            split[c["name"]] = round(split.get(c["name"], 0.0) + c.get("dur", 0) / 1e3, 3)
+        requests.append({
+            "req": args.get("req"),
+            "status": args.get("status"),
+            "bucket": args.get("bucket"),
+            "total_ms": round(total_ms, 3),
+            "phases_ms": split,
+            "other_ms": round(total_ms - sum(split.values()), 3),
+        })
+    requests.sort(key=lambda r: (r["req"] is None, r["req"]))
+
+    # --- instants / counters ---------------------------------------------
+    inst_counts: dict[str, int] = {}
+    for e in instants:
+        key = f"{e.get('cat', '')}/{e['name']}"
+        inst_counts[key] = inst_counts.get(key, 0) + 1
+    counter_last: dict[str, float] = {}
+    for e in counters:  # export order is chronological; last write wins
+        for k, v in (e.get("args") or {}).items():
+            counter_last[f"{e['name']}.{k}"] = v
+
+    return {
+        "n_events": len(events),
+        "n_spans": len(spans),
+        "phases": phase_rows,
+        "requests": requests,
+        "instants": dict(sorted(inst_counts.items())),
+        "counters_last": dict(sorted(counter_last.items())),
+    }
+
+
+def _fmt_table(rows: list[dict], cols: list[str]) -> str:
+    if not rows:
+        return "  (none)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
+    head = "  " + "  ".join(c.ljust(widths[c]) for c in cols)
+    sep = "  " + "  ".join("-" * widths[c] for c in cols)
+    body = [
+        "  " + "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols)
+        for r in rows
+    ]
+    return "\n".join([head, sep] + body)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="path to a Tracer.export_trace JSON file")
+    ap.add_argument("--json", action="store_true", help="emit one JSON line")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if validate_trace finds problems")
+    ap.add_argument("--top", type=int, default=0,
+                    help="limit per-request rollup to the N slowest (0 = all)")
+    args = ap.parse_args(argv)
+
+    problems = validate_trace(args.trace)
+    if problems and args.strict:
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        return 1
+
+    report = analyze(load_trace(args.trace))
+    report["problems"] = problems
+    if args.top:
+        report["requests"] = sorted(
+            report["requests"], key=lambda r: -r["total_ms"]
+        )[: args.top]
+
+    if args.json:
+        json.dump(report, sys.stdout, allow_nan=False)
+        print()
+        return 0
+
+    print(f"trace: {args.trace}  ({report['n_events']} events, "
+          f"{report['n_spans']} spans)")
+    if problems:
+        print(f"\n!! {len(problems)} validation problem(s):")
+        for p in problems:
+            print(f"  - {p}")
+    print("\nPer-phase latency (ms):")
+    print(_fmt_table(report["phases"],
+                     ["phase", "count", "total_ms", "mean_ms", "p50_ms",
+                      "p95_ms", "max_ms"]))
+    if report["requests"]:
+        print("\nPer-request rollup (ms):")
+        rows = [
+            {**{k: r[k] for k in ("req", "status", "bucket", "total_ms",
+                                  "other_ms")},
+             "phases": " ".join(f"{k}={v}" for k, v in r["phases_ms"].items())}
+            for r in report["requests"]
+        ]
+        print(_fmt_table(rows, ["req", "status", "bucket", "total_ms",
+                                "phases", "other_ms"]))
+    if report["instants"]:
+        print("\nInstant events:")
+        for k, v in report["instants"].items():
+            print(f"  {k}: {v}")
+    if report["counters_last"]:
+        print("\nCounters (last value):")
+        for k, v in report["counters_last"].items():
+            print(f"  {k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,70 @@ def test_step_timer_and_profile_fn():
     assert s["items_per_sec"] > 0 and len(timer.times) == 3
 
 
+def test_step_timer_summary_with_zero_post_warmup_samples():
+    """ISSUE 6 satellite: warmup >= recorded steps used to push an empty
+    array through np.percentile (NaN + RuntimeWarning) and emit NaN into
+    strict-JSON metric records.  Now every statistic is None (null), the
+    same convention MetricWriter._sanitize enforces."""
+    import json
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.arange(8.0)
+    for warmup, n_steps in ((2, 1), (1, 1), (5, 0)):
+        timer = StepTimer(warmup=warmup)
+        for _ in range(n_steps):
+            with timer.step() as t:
+                t.set_fence(f(x))
+        s = timer.summary(items_per_step=8)
+        assert s["steps"] == n_steps  # total recorded, warmup included
+        assert s["mean_s"] is None and s["p50_s"] is None
+        assert s["p90_s"] is None and s["max_s"] is None
+        assert s["items_per_sec"] is None
+        json.dumps(s, allow_nan=False)  # strict-JSON clean, no NaN tokens
+    # without items_per_step the key must stay absent, as before
+    assert "items_per_sec" not in StepTimer(warmup=3).summary()
+
+
+def test_step_timer_warmup_exclusion_and_fencing():
+    """StepTimer drops exactly `warmup` leading samples, and set_fence
+    blocks on the device value so the recorded time covers the compute."""
+    timer = StepTimer(warmup=2)
+    f = jax.jit(lambda x: jnp.sum(x * x))
+    x = jnp.arange(512.0)
+    for _ in range(6):
+        with timer.step() as t:
+            t.set_fence(f(x))
+    assert len(timer.times) == 4  # 6 recorded - 2 warmup
+    s = timer.summary()
+    assert s["steps"] == 6  # total recorded, warmup included
+    assert s["max_s"] >= s["p90_s"] >= s["p50_s"] > 0
+    # a fence-less step still records (wall time only)
+    bare = StepTimer(warmup=0)
+    with bare.step():
+        pass
+    assert len(bare.times) == 1 and bare.times[0] >= 0
+
+
+def test_trace_session_stop_is_idempotent(tmp_path):
+    """TraceSession: stop() without start() is a no-op, double stop() is
+    a no-op, and `active` tracks the lifecycle."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.profiling import TraceSession
+
+    sess = TraceSession(str(tmp_path / "never_started"))
+    assert not sess.active
+    sess.stop()  # never started: must not raise
+    assert not sess.active
+
+    sess2 = TraceSession(str(tmp_path / "tb_trace"))
+    sess2.start()
+    assert sess2.active
+    jnp.sum(jnp.arange(64.0)).block_until_ready()  # something to record
+    sess2.stop()
+    assert not sess2.active
+    sess2.stop()  # second stop: swallowed, not a crash
+    assert not sess2.active
+
+
 def test_profile_dir_captures_fit_trace(tmp_path):
     """RunConfig.profile_dir (VERDICT.md r2 item 4): fit() writes a
     TensorBoard-profile capture of the steady-state epochs."""
@@ -216,6 +280,37 @@ def test_metric_writer_context_manager_closes_on_exception(tmp_path):
         pass
     assert not shared._file.closed
     shared.close()
+
+
+def test_metric_writer_close_is_idempotent_and_write_after_close_is_clear(tmp_path):
+    """ISSUE 6 satellite: double close() is a no-op (components share
+    writers — trainer teardown after an explicit close must not raise),
+    and write() after close() is a clear RuntimeError naming the problem,
+    not a ValueError from deep inside file I/O."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+    path = tmp_path / "closed.jsonl"
+    w = MetricWriter(path=str(path), stdout=False)
+    w.write("epoch", step=1, loss=0.5)
+    w.close()
+    w.close()  # idempotent: second close must not raise
+
+    with pytest.raises(RuntimeError, match="closed"):
+        w.write("epoch", step=2, loss=0.4)
+    # the failed write lost nothing that was already durable
+    assert len(path.read_text().splitlines()) == 1
+
+    # the context-manager form hits the same idempotent path
+    with MetricWriter(path=str(tmp_path / "cm.jsonl"), stdout=False) as w2:
+        w2.close()  # explicit close inside the body; __exit__ closes again
+    with pytest.raises(RuntimeError, match="closed"):
+        w2.write("late")
+
+    # a stdout-only writer (no file) gets the same contract
+    w3 = MetricWriter(stdout=False)
+    w3.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w3.write("late")
 
 
 def test_metric_writer_sanitizes_non_finite_to_null(tmp_path):

@@ -1,0 +1,480 @@
+"""End-to-end tracing + compile accounting (ISSUE 6, utils/tracing.py).
+
+The decisive properties:
+
+* EXPORT VALIDITY — a chaos-enabled serving soak exports STRICT
+  Chrome-trace JSON: every span closed, every parent resolving, no
+  NaN/Infinity tokens (``validate_trace`` is the mechanical check, and
+  the tests also pin what it checks).
+* CORRELATION — each request's root span duration matches its reported
+  latency (one shared monotonic clock), and injected chaos faults attach
+  to the requests they actually hit.
+* COMPILE ACCOUNTING — ``CompileTracker`` counts only programs actually
+  compiled (repeats are cache hits: zero), attributed to the site that
+  triggered them.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
+    InferenceEngine,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+    CompileTracker,
+    Tracer,
+    load_trace,
+    validate_trace,
+)
+
+KW = dict(num_classes=16, dim=64, depth=2, heads=4, dtype=jnp.float32)
+
+
+def _model_and_params(seed=0):
+    model = get_model("causal_lm", **KW)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _spans(doc):
+    return [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+
+
+# ----------------------------------------------------------------------
+# Tracer unit behaviour
+
+
+def test_tracer_span_tree_counters_and_summary():
+    clock = iter(np.arange(0.0, 10.0, 0.125))
+    tr = Tracer(clock=lambda: float(next(clock)))
+    root = tr.begin("request", cat="serving", req=7)
+    child = tr.begin("queue", cat="serving", parent=root)
+    tr.end(child)
+    with tr.span("decode", cat="serving", parent=root, slot=1):
+        tr.instant("first_token", cat="serving", parent=root, slot=1)
+    tr.counter("queue_depth", 3)
+    tr.end(root, status="done")
+    assert tr.open_spans == 0 and tr.dropped == 0
+
+    events = tr.events()
+    assert [e["name"] for e in events] == [
+        "queue", "first_token", "decode", "queue_depth", "request"]
+    req = events[-1]
+    assert req["args"]["req"] == 7 and req["args"]["status"] == "done"
+    # children closed before the root carry its id as parent
+    assert events[0]["parent"] == req["id"]
+
+    s = tr.summary()
+    assert s["events"] == len(events) and s["open_spans"] == 0
+    assert s["phases"]["serving/request"]["n"] == 1
+    assert s["phases"]["serving/decode"]["total_s"] > 0
+    assert s["counters"]["queue_depth"] == 3.0
+    json.dumps(s, allow_nan=False)  # strict-JSON clean
+
+
+def test_tracer_end_of_unknown_span_is_ignored():
+    tr = Tracer()
+    tr.end(12345)  # never began: must not raise (retirement races)
+    sid = tr.begin("x")
+    tr.end(sid)
+    tr.end(sid)  # double end: second is a no-op
+    assert tr.open_spans == 0 and len(tr.events()) == 1
+
+
+def test_tracer_ring_bound_drops_closed_never_open():
+    tr = Tracer(capacity=8)
+    root = tr.begin("request")  # open: must survive any overflow
+    for i in range(50):
+        tr.instant("tick", i=i)
+    assert len(tr.events()) == 8 and tr.dropped == 42
+    tr.end(root, status="done")  # still closable after the wrap
+    assert tr.open_spans == 0
+    assert tr.summary()["dropped"] == 43  # the close evicted one more tick
+    # the root landed even though the instants around it were evicted
+    assert tr.events()[-1]["name"] == "request"
+
+
+def test_export_strict_json_validates_and_names_tracks(tmp_path):
+    tr = Tracer()
+    tid = tr.track("req 0")
+    root = tr.begin("request", cat="serving", tid=tid, req=0)
+    with tr.span("decode", cat="serving", parent=root, tid=tid):
+        pass
+    tr.end(root, status="done")
+    tr.counter("queue_depth", 0)
+    path = tmp_path / "t.trace.json"
+    out = tr.export_trace(str(path))
+    assert out["events"] > 0 and out["path"] == str(path)
+
+    assert validate_trace(str(path)) == []
+    doc = load_trace(str(path))
+    assert doc["displayTimeUnit"] == "ms"
+    names = {(e["ph"], e.get("name")) for e in doc["traceEvents"]}
+    assert ("M", "thread_name") in names and ("C", "queue_depth") in names
+    spans = _spans(doc)
+    ids = [e["args"]["id"] for e in spans]
+    assert len(ids) == len(set(ids)) == 2
+    # the child's parent resolves to the root's exported id
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["decode"]["args"]["parent"] == by_name["request"]["args"]["id"]
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in spans)
+
+
+def test_export_flags_open_spans_and_validator_rejects(tmp_path):
+    tr = Tracer()
+    tr.begin("request", req=1)  # never ended
+    path = tmp_path / "open.trace.json"
+    tr.export_trace(str(path))
+    doc = load_trace(str(path))
+    assert any(e["ph"] == "B" for e in doc["traceEvents"])
+    problems = validate_trace(str(path))
+    assert problems and any("unclosed" in p for p in problems)
+
+
+def test_export_drops_dangling_parent_refs(tmp_path):
+    """A child whose parent was ring-evicted exports WITHOUT the parent
+    arg — a wrapped trace still passes parent-resolution validation."""
+    tr = Tracer(capacity=2)
+    root = tr.begin("request")
+    tr.end(root)
+    for i in range(5):  # evict the root from the ring
+        tr.instant("tick", i=i)
+    child = tr.begin("late", parent=root)
+    tr.end(child)
+    path = tmp_path / "wrap.trace.json"
+    tr.export_trace(str(path))
+    assert validate_trace(str(path)) == []
+    late = [e for e in _spans(load_trace(str(path))) if e["name"] == "late"]
+    assert late and "parent" not in late[0]["args"]
+
+
+def test_load_trace_rejects_nonstrict_json(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"traceEvents": [{"ph": "X", "ts": NaN}]}')
+    with pytest.raises(ValueError, match="non-strict"):
+        load_trace(str(p))
+    assert any("strict" in s or "parse" in s for s in validate_trace(str(p)))
+
+
+# ----------------------------------------------------------------------
+# CompileTracker
+
+
+def test_compile_tracker_singleton_and_site_attribution():
+    tracker = CompileTracker.install()
+    assert CompileTracker.install() is tracker  # one per process
+    if tracker.mode == "unavailable":
+        pytest.skip("no compile hook on this jax build")
+
+    before = tracker.snapshot()
+    f = jax.jit(lambda x: x * 2 + 1)
+    with tracker.site("test_site_a"):
+        f(jnp.arange(7.0)).block_until_ready()
+    mid = tracker.snapshot()
+    d1 = CompileTracker.delta(mid, before)
+    assert d1["n_compiled_programs"] >= 1
+    assert "test_site_a" in d1["by_site"]
+
+    # the SAME program again: a tracing-cache hit compiles nothing
+    with tracker.site("test_site_b"):
+        f(jnp.arange(7.0)).block_until_ready()
+    d2 = CompileTracker.delta(tracker.snapshot(), mid)
+    assert d2["n_compiled_programs"] == 0 and d2["by_site"] == {}
+
+
+def test_compile_tracker_bound_tracer_gets_instants():
+    tracker = CompileTracker.install()
+    if tracker.mode != "monitoring":
+        pytest.skip("xla_compile instants need the monitoring hook")
+    tr = Tracer()
+    tracker.bind(tr)
+    try:
+        with tracker.site("bound_site"):
+            jax.jit(lambda x: x - 3)(jnp.arange(5.0)).block_until_ready()
+    finally:
+        tracker.bind(None)
+    hits = [e for e in tr.events()
+            if e["name"] == "xla_compile" and e["args"]["site"] == "bound_site"]
+    assert hits and hits[0]["args"]["compile_time_s"] > 0
+
+
+# ----------------------------------------------------------------------
+# serving integration: the ISSUE 6 acceptance pin
+
+
+def _traced_engine(model, params, tracer, chaos=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 24)
+    return InferenceEngine(
+        model, params, chaos=chaos, tracer=tracer,
+        scheduler=FIFOScheduler(max_len=kw["max_len"], buckets=(8,)), **kw)
+
+
+def test_serving_trace_end_to_end_with_chaos(tmp_path):
+    """Chaos-enabled serving run -> export -> validate: every span
+    closed, parents resolve, strict JSON; each request's root span
+    duration matches its reported latency (shared clock); the injected
+    fault attaches to the request it hit and no other."""
+    model, params = _model_and_params()
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="serving-admit", kind="poison", at=(1,)),
+    )))
+    tr = Tracer()
+    eng = _traced_engine(model, params, tr, chaos=inj, decode_ahead=2,
+                         prefix_cache_bytes=16 << 20)
+    rng = np.random.default_rng(0)
+    reqs = []
+    repeat = np.asarray([3, 1, 4, 1, 5], np.int32)  # prefix-cache bait
+    for i in range(6):
+        prompt = (repeat if i >= 4 else
+                  rng.integers(1, 16, size=(2 + i % 4,)).astype(np.int32))
+        reqs.append(eng.submit(prompt, max_new=3 + i % 3))
+    done = eng.run()
+    assert len(done) == 6 and tr.open_spans == 0
+
+    path = tmp_path / "serving.trace.json"
+    tr.export_trace(str(path))
+    assert validate_trace(str(path)) == []
+    doc = load_trace(str(path))
+    spans = _spans(doc)
+    roots = {e["args"]["req"]: e for e in spans if e["name"] == "request"}
+    assert set(roots) == {r.id for r in reqs}
+
+    for r in reqs:
+        root = roots[r.id]
+        if r.status == "done":
+            want_s = r.finish_t - r.submit_t
+            assert abs(root["dur"] / 1e6 - want_s) < 0.05, r.id
+            # child phases tile the root: queue+admit+decode <= total
+            kids = [e for e in spans
+                    if e["args"].get("parent") == root["args"]["id"]]
+            assert {"queue", "decode"} <= {k["name"] for k in kids}
+            assert sum(k["dur"] for k in kids if k["name"] != "prefill"
+                       ) <= root["dur"] * 1.02 + 1000
+        assert root["args"]["status"] == r.status
+
+    # the fault landed on request 1's track, parented under ITS root
+    faults = [e for e in doc["traceEvents"] if e["name"] == "chaos_fault"]
+    assert len(faults) == 1
+    assert faults[0]["args"]["parent"] == roots[reqs[1].id]["args"]["id"]
+    assert faults[0]["args"]["site"] == "serving-admit"
+    assert reqs[1].status == "failed"
+    assert roots[reqs[1].id]["args"]["status"] == "failed"
+
+    # prefix-cache hit instants attach to the repeated-prompt requests
+    hits = [e for e in doc["traceEvents"] if e["name"] == "prefix_cache_hit"]
+    assert len(hits) == 1  # req 5 hits what req 4 stored
+    assert hits[0]["args"]["parent"] == roots[reqs[5].id]["args"]["id"]
+
+    # stats carry the compile ledger (null only when the hook is absent)
+    s = eng.stats.summary()
+    if CompileTracker.install().mode != "unavailable":
+        assert s["n_compiled_programs"] >= 1
+        assert any(k.startswith("prefill[b8]") for k in s["compile_by_site"])
+    else:
+        assert s["n_compiled_programs"] is None
+
+
+def test_engine_close_closes_all_request_spans():
+    model, params = _model_and_params()
+    tr = Tracer()
+    eng = _traced_engine(model, params, tr)
+    for i in range(4):  # 2 slots: 2 admit, 2 stay queued
+        eng.submit(np.asarray([1, 2, 3], np.int32), max_new=4)
+    eng.close()
+    assert tr.open_spans == 0
+    statuses = [e["args"]["status"] for e in tr.events()
+                if e["name"] == "request"]
+    assert len(statuses) == 4 and set(statuses) == {"cancelled"}
+
+
+def test_engine_rejects_two_different_tracers():
+    model, params = _model_and_params()
+    sched = FIFOScheduler(max_len=24, buckets=(8,), tracer=Tracer())
+    with pytest.raises(ValueError, match="tracer"):
+        InferenceEngine(model, params, slots=2, max_len=24,
+                        tracer=Tracer(), scheduler=sched)
+    # engine adopts the scheduler's tracer when it has none
+    eng = InferenceEngine(model, params, slots=2, max_len=24, scheduler=sched)
+    assert eng._tracer is sched.tracer
+
+
+def test_tracerless_engine_has_no_tracer_state():
+    """The nil-guard zero-overhead contract, structurally: no tracer ->
+    every site is one attribute test, and no spans exist anywhere."""
+    model, params = _model_and_params()
+    eng = InferenceEngine(
+        model, params, slots=2, max_len=24,
+        scheduler=FIFOScheduler(max_len=24, buckets=(8,)))
+    assert eng._tracer is None and eng.scheduler.tracer is None
+    r = eng.submit(np.asarray([1, 2], np.int32), max_new=3)
+    eng.run()
+    assert r.trace is None and r.status == "done"
+
+
+# ----------------------------------------------------------------------
+# training integration
+
+
+def test_trainer_trace_spans_and_compile_summary(tmp_path):
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    tr = Tracer()
+    cfg = RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, synthetic=True,
+        n_train=256, n_test=64, batch_size=64, epochs=2, dp=1, quiet=True,
+        eval_every=1, checkpoint_every=1, input_mode="stream",
+        stream_chunk=2, checkpoint_dir=str(tmp_path / "ck"),
+    )
+    t = Trainer(cfg, tracer=tr)
+    summary = t.fit()
+    assert tr.open_spans == 0
+    names = {(e["cat"], e["name"]) for e in tr.events()}
+    assert {("train", "epoch_dispatch"), ("train", "fetch"),
+            ("train", "eval"), ("train", "h2d"), ("train", "dispatch"),
+            ("train", "checkpoint_save")} <= names
+
+    # restore traces too
+    t2 = Trainer(cfg.replace(resume=True), tracer=tr)
+    step = t2.restore_checkpoint()
+    assert step > 0
+    restores = [e for e in tr.events() if e["name"] == "checkpoint_restore"]
+    assert restores and restores[-1]["args"]["restored_step"] == step
+
+    path = tmp_path / "train.trace.json"
+    tr.export_trace(str(path))
+    assert validate_trace(str(path)) == []
+
+    # fit summary carries the compile ledger
+    if CompileTracker.install().mode != "unavailable":
+        assert summary["n_compiled_programs"] >= 1
+        assert summary["compile_time_s"] >= 0
+    else:
+        assert summary["n_compiled_programs"] is None
+
+
+def test_elastic_restart_instant_lands_on_timeline(tmp_path):
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+    from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+        FaultInjector as FI,
+        FaultPlan as FP,
+        FaultSpec as FS,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+    from distributed_tensorflow_ibm_mnist_tpu.utils.elastic import (
+        run_with_recovery,
+    )
+
+    tr = Tracer()
+    cfg = RunConfig(
+        model="mlp", model_kwargs={"hidden": (32,)}, synthetic=True,
+        n_train=256, n_test=64, batch_size=64, epochs=2, dp=1, quiet=True,
+        checkpoint_every=1, checkpoint_dir=str(tmp_path / "ck"),
+        input_mode="stream", stream_chunk=2,
+    )
+    inj = FI(FP(faults=(FS(site="data-batch", kind="io", at=(3,)),)))
+    summary = run_with_recovery(
+        lambda: Trainer(cfg, chaos=inj), max_restarts=2,
+        backoff_base_s=0.0, tracer=tr)
+    assert summary["restarts"] == 1
+    restarts = [e for e in tr.events() if e["name"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["cat"] == "elastic"
+    assert restarts[0]["args"]["exception"] == "OSError"
+    assert restarts[0]["args"]["attempt"] == 1
+    # the supervised trainer inherited the tracer: the fit spans of every
+    # attempt land on the SAME timeline as the restart instant
+    assert any(e["name"] == "epoch_dispatch" for e in tr.events())
+    assert tr.open_spans == 0
+
+
+# ----------------------------------------------------------------------
+# trace_report
+
+
+def test_trace_report_analyze_and_cli(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+
+    model, params = _model_and_params()
+    tr = Tracer()
+    eng = _traced_engine(model, params, tr)
+    for i in range(3):
+        eng.submit(np.asarray([1, 2, 3 + i], np.int32), max_new=3)
+    eng.run()
+    path = tmp_path / "r.trace.json"
+    tr.export_trace(str(path))
+
+    rep = trace_report.analyze(load_trace(str(path)))
+    assert rep["n_spans"] > 0
+    assert any(p["phase"] == "serving/request" for p in rep["phases"])
+    assert len(rep["requests"]) == 3
+    for r in rep["requests"]:
+        assert r["status"] == "done"
+        assert r["total_ms"] >= sum(r["phases_ms"].values()) * 0.98 - 1.0
+        assert "decode" in r["phases_ms"]
+
+    # the CLI form: --json emits the same analysis as one strict line
+    out = subprocess.run(
+        [sys.executable, os.path.join("scripts", "trace_report.py"),
+         str(path), "--json", "--strict"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout)
+    assert rec["problems"] == [] and len(rec["requests"]) == 3
+
+
+# ----------------------------------------------------------------------
+# bench harness smoke (slow: subprocess + fresh jax init); the fast legs
+# above cover the library — this pins the harness itself
+
+
+@pytest.mark.slow
+def test_bench_compile_census_quick_smoke():
+    """The compile-census acceptance figure, end to end in a subprocess:
+    n_compiled_programs moves when (and only when) a new bucket appears."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys, os, json; "
+        "sys.path.insert(0, os.path.join(%r, 'scripts')); "
+        "from bench_serving import run_compile_census; "
+        "print(json.dumps(run_compile_census(2)))" % root)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DTM_BENCH_QUICK="1")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    if rec["mode"] == "unavailable":
+        pytest.skip("no compile hook in subprocess jax")
+    assert rec["repeat_compiles_zero"] is True
+    assert rec["new_bucket_compiles"] is True
+    census = rec["legs"]
+    assert census["bucket16_first"]["n_new_programs"] > 0
+    assert census["bucket32_new"]["n_new_programs"] >= 1
+    # the new bucket's compiles are its prefill program — decode/insert/
+    # reset are bucket-invariant and must all be cache hits
+    assert "prefill[b32]" in census["bucket32_new"]["by_site"]
+    for site in ("decode_window", "slot_insert", "slot_reset"):
+        assert not any(k.startswith(site)
+                       for k in census["bucket32_new"]["by_site"])
