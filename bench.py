@@ -299,6 +299,44 @@ def main() -> None:
 
             print(f"bench: serving phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 5b — the paged-KV memory model (ISSUE 7): dense vs paged+radix
+    # peak concurrent sessions at a FIXED HBM budget on a shared-system-
+    # prompt stream (scripts/bench_kv_paging.py in a SUBPROCESS, CPU
+    # backend; greedy token parity between the legs is enforced by the
+    # harness itself).  Skippable with the serving phase; never sinks the
+    # headline.
+    kv_paging = None
+    if not os.environ.get("DTM_BENCH_SKIP_SERVING"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_kv_paging.py")],
+                capture_output=True, text=True, timeout=480, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "kv_paging":
+                    kv_paging = rec
+            if kv_paging is None:
+                print(
+                    f"bench: kv_paging subprocess produced no record "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            print(f"bench: kv_paging phase failed: {e!r}", file=sys.stderr)
+
     # Phase 6 — the chaos soak (ISSUE 3): seeded multi-fault plans against
     # training (torn checkpoint write, NaN step, checkpoint-read + data-
     # batch I/O faults -> bit-identical recovery) and serving (poisoned
@@ -408,6 +446,10 @@ def main() -> None:
     if serving is not None:
         result["serving"] = {
             k: v for k, v in serving.items() if k != "metric"
+        }
+    if kv_paging is not None:
+        result["kv_paging"] = {
+            k: v for k, v in kv_paging.items() if k != "metric"
         }
     if chaos is not None:
         result["chaos"] = {

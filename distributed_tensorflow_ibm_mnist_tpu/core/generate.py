@@ -160,7 +160,16 @@ def make_prefill(model, max_len: int) -> Callable:
     logits at each row's last real position — pick from these for the first
     generated token.  Compiles once per (B, P) shape; bucket prompt lengths
     (serving/scheduler.py) to bound the shape set.
+
+    Prefill always emits the DENSE row layout, even when the engine decodes
+    paged (``page_size > 0``): the prompt runs through the ordinary forward
+    (no cache involved), and the paged engine scatters the dense row into
+    its page pool on insert (serving/kv_pool.py ``make_paged_insert``) —
+    the prefill program is byte-identical between the two cache layouts,
+    so switching layouts never recompiles the prefill family.
     """
+    if getattr(model, "page_size", 0):
+        model = model.clone(page_size=0)  # prefill is layout-agnostic
     if max_len < 1:
         raise ValueError(f"max_len must be >= 1, got {max_len}")
     if getattr(model, "sow_kv", None) is False:
@@ -255,6 +264,13 @@ def make_decode_window(model, max_len: int, window: int, ragged: bool = True,
     :func:`make_decode_step` calls (pinned in tests/test_decode_ahead.py);
     sampled windows consume keys in scan order, so parity holds only for
     the same key schedule.
+
+    The window is CACHE-LAYOUT agnostic: pass a paged model clone
+    (``page_size > 0``) and the paged cache pytree from
+    ``serving.kv_pool.init_paged_cache`` and the same scan decodes through
+    the page pool — the layout lives in the model + cache contents, not in
+    this wrapper (paged greedy windows are token-identical to dense ones;
+    pinned in tests/test_kv_paging.py).
     """
     if max_len < 1:
         raise ValueError(f"max_len must be >= 1, got {max_len}")
@@ -291,7 +307,17 @@ def init_cache(model, params, batch: int, max_len: int):
     """A zeroed (batch, max_len) decode-cache pytree in the model's decode
     layout (same structure/dtypes a real prefill produces) — the serving
     engine's slot cache before any request is admitted.  Built from
-    ``jax.eval_shape`` of the decode apply, so no forward pass runs."""
+    ``jax.eval_shape`` of the decode apply, so no forward pass runs.
+
+    DENSE layout only: a paged model (``page_size > 0``) decodes through a
+    shared page pool whose size is serving configuration, not a model
+    attribute — build that with ``serving.kv_pool.init_paged_cache``."""
+    if getattr(model, "page_size", 0):
+        raise ValueError(
+            "init_cache builds the dense (batch, max_len) slot cache; a "
+            "paged model (page_size > 0) decodes through a page pool — "
+            "build it with serving.kv_pool.init_paged_cache, which also "
+            "sizes the pool (n_pages is engine config)")
     shapes = jax.eval_shape(
         lambda p: model.apply(
             {"params": p}, jnp.zeros((batch, 1), jnp.int32),

@@ -79,8 +79,13 @@ def _enable_compile_cache(cache_dir: str | None) -> None:
     """Point jax's persistent compilation cache at ``cache_dir``.
 
     ``cache_dir`` semantics per :func:`resolve_compile_cache_dir`; None
-    disables.  Idempotent and safe to call after jax is initialized (the
-    cache is consulted at compile time, not at backend creation).
+    disables.  Idempotent and safe to call after jax is initialized AND
+    after compiles have already happened: jax latches its cache state at
+    the first compile of the process (no configured dir then = cache off
+    forever), so pointing the config at a new dir also resets that latch —
+    without the reset, enabling the cache from anything constructed after
+    a first jit (an InferenceEngine built once params exist, a Trainer
+    after a data-pipeline warmup) would be a silent no-op.
     """
     cache_dir = resolve_compile_cache_dir(cache_dir)
     if cache_dir is None:
@@ -102,6 +107,9 @@ def _enable_compile_cache(cache_dir: str | None) -> None:
             # cache even fast compiles: the hot configs here compile in
             # seconds but are re-run constantly (benchmarks, CI, presets)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()  # drop the lazily-latched state (any state)
     except Exception:
         pass  # cache is an optimization; never fail a run over it
 

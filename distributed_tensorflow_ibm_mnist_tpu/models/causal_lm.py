@@ -63,6 +63,10 @@ class CausalLM(nn.Module):
     kv_cache_dtype: str = "native"  # "int8": quantized decode cache with
     #   per-(position, head) scales — halves the decode's dominant HBM
     #   stream (models/transformer.quantize_kv_int8); training is untouched
+    page_size: int = 0  # >0: paged decode cache — blocks read/write K/V
+    #   through a shared page pool + block table (serving/kv_pool.py)
+    #   instead of dense (B, max_len) rows; serving engine state, training
+    #   and prefill are untouched (see TransformerBlock.page_size)
     tie_embeddings: bool = False  # share the token embedding with the
     #   output head (logits = x @ embed^T): V*dim fewer params, the
     #   standard small-LM regularizer.  The Megatron rule's feature-dim
@@ -170,6 +174,7 @@ class CausalLM(nn.Module):
                 moe_top_k=self.moe_top_k, moe_z_weight=self.moe_z_weight,
                 moe_fn=self.moe_fn, rope=rope, sow_kv=self.sow_kv,
                 window=self.window, kv_cache_dtype=self.kv_cache_dtype,
+                page_size=self.page_size,
                 dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)

@@ -116,6 +116,58 @@ def reset_cache_slots(cache, slot_mask):
     return jax.tree.map(_reset, cache)
 
 
+def _attend_cached(q, kc, vc, ksc, vsc, mask, dtype):
+    """Score queries against a gathered cache span — the shared tail of the
+    dense and paged decode-attention paths.
+
+    ``kc``/``vc`` are (B, L, H_kv, D) cache operands in their STORED dtype
+    (int8 payloads convert to ``dtype`` inside the contraction, keeping the
+    HBM stream int8-sized); ``ksc``/``vsc`` are the per-(position, head)
+    int8 scales or None for native caches; ``mask`` is (B|1, S, L).  The
+    int8 scales apply at (q, k)-pair granularity: scores pick up k_scale
+    per key position and probabilities fold v_scale before the PV
+    contraction — both D-times cheaper than dequantizing the cache, and
+    the softmax sees exactly the dequantized scores.  GQA queries score a
+    grouped einsum against the hkv-sized cache with no materialized repeat.
+    """
+    import jax
+
+    b, s, h, d = q.shape
+    hkv = kc.shape[2]
+    quant = ksc is not None
+    scale = d ** -0.5
+    kc_op = kc.astype(dtype) if quant else kc
+    vc_op = vc.astype(dtype) if quant else vc
+    if hkv != h:
+        qg = q.reshape(b, s, hkv, h // hkv, d)
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, kc_op,
+            preferred_element_type=jnp.float32) * scale
+        if quant:
+            scores = scores * ksc.transpose(0, 2, 1)[:, :, None, None, :]
+        scores = jnp.where(mask[:, None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        if quant:
+            p = p * vsc.transpose(0, 2, 1)[:, :, None, None, :]
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p.astype(dtype), vc_op,
+            preferred_element_type=jnp.float32).reshape(b, s, h, d)
+    else:
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kc_op,
+            preferred_element_type=jnp.float32) * scale
+        if quant:
+            scores = scores * ksc.transpose(0, 2, 1)[:, :, None, :]
+        scores = jnp.where(mask[:, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        if quant:
+            p = p * vsc.transpose(0, 2, 1)[:, :, None, :]
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(dtype), vc_op,
+            preferred_element_type=jnp.float32)
+    return out.astype(dtype)
+
+
 def _resolve_attn(attn_fn: Callable | None, attn: str) -> Callable:
     """attn_fn (explicit callable, e.g. a ring-attention island) wins; else
     pick by name: 'vanilla' (XLA) or 'flash' (the Pallas kernel) — a string
@@ -161,6 +213,11 @@ class TransformerBlock(nn.Module):
     #   cache (O(S*max_len) scores, OOM for long prompts)
     kv_cache_dtype: str = "native"  # "native" (= dtype) | "int8": quantized
     #   decode cache with per-(position, head) scales — see quantize_kv_int8
+    page_size: int = 0  # >0: PAGED decode cache — K/V live in a shared
+    #   (n_pages, page_size, H_kv, D) pool indexed through a per-row
+    #   (B, max_len/page_size) block table instead of a dense
+    #   (B, max_len, ...) slab; see _paged_decode_attention.  The pool is
+    #   engine state (serving/kv_pool.py), never initialized here.
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -277,6 +334,8 @@ class TransformerBlock(nn.Module):
                 f"kv_cache_dtype must be 'native' or 'int8', got "
                 f"{self.kv_cache_dtype!r}"
             )
+        if self.page_size > 0:
+            return self._paged_decode_attention(q, k, v, max_len)
         b, s, h, d = q.shape
         hkv = k.shape[2]  # GQA: the cache is heads_kv-sized — the memory win
         quant = self.kv_cache_dtype == "int8"
@@ -392,45 +451,100 @@ class TransformerBlock(nn.Module):
         mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B|1, S, span|max_len)
         if self.window:
             mask &= k_pos[:, None, :] > q_pos[:, :, None] - self.window
-        scale = d ** -0.5
-        # int8 cache: the payload converts to the compute dtype INSIDE the
-        # contraction (a fused convert — the HBM stream stays int8-sized)
-        # and the scales apply at (q, k)-pair granularity: scores pick up
-        # k_scale per key position, probabilities fold v_scale before the
-        # PV contraction — both D-times cheaper than dequantizing the
-        # cache, and the softmax sees exactly the dequantized scores.
-        kc_op = kc.astype(self.dtype) if quant else kc
-        vc_op = vc.astype(self.dtype) if quant else vc
-        if hkv != h:
-            # grouped einsum against the hkv-sized cache — no materialized
-            # repeat (the smaller cache bandwidth IS the GQA decode win)
-            qg = q.reshape(b, s, hkv, h // hkv, d)
-            scores = jnp.einsum(
-                "bqhgd,bkhd->bhgqk", qg, kc_op,
-                preferred_element_type=jnp.float32) * scale
-            if quant:
-                scores = scores * ksc.transpose(0, 2, 1)[:, :, None, None, :]
-            scores = jnp.where(mask[:, None, None], scores, -1e30)
-            p = jax.nn.softmax(scores, axis=-1)
-            if quant:
-                p = p * vsc.transpose(0, 2, 1)[:, :, None, None, :]
-            out = jnp.einsum(
-                "bhgqk,bkhd->bqhgd", p.astype(self.dtype), vc_op,
-                preferred_element_type=jnp.float32).reshape(b, s, h, d)
+        return _attend_cached(q, kc, vc, ksc, vsc, mask, self.dtype)
+
+    def _paged_decode_attention(self, q, k, v, max_len: int):
+        """Paged decode attention: K/V live in a POOLED
+        ``(n_pages, page_size, H_kv, D)`` slab per layer, and each batch row
+        owns a ``(max_len / page_size,)`` row of the ``block_table`` mapping
+        its virtual positions to pool pages.  Memory then scales with LIVE
+        tokens (pages allocated on admission, freed on retirement) instead
+        of ``slots * max_len``, and read-only pages can be SHARED between
+        rows (the radix prefix cache, serving/radix_cache.py) because this
+        path writes only the current chunk's positions — never a whole row.
+
+        Writes scatter each new K/V position to ``(block_table[pos // ps],
+        pos % ps)``; reads gather the row's full virtual span
+        ``pool[block_table]`` back to (B, max_len, H_kv, D) and reuse the
+        dense tail (same mask, same reduction shapes), which is what makes
+        paged greedy decoding token-identical to the dense layout.
+        ``max_len`` must be a page multiple so the virtual span is exactly
+        max_len.  Write positions clamp at max_len - 1 exactly like the
+        dense path's ``dynamic_update_slice`` clamp (decode-ahead overrun
+        rows); unallocated block-table entries point at the reserved trash
+        page 0, whose garbage is never exposed: a row's mask only admits
+        positions below its cursor, all of which lie in allocated pages.
+
+        The pool, block table, and cursor are ENGINE state: the init fns
+        raise, because pool size is serving configuration
+        (serving/kv_pool.py builds it), not a model attribute.  Sliding
+        windows are rejected — the windowed span slice assumes dense
+        contiguity.
+        """
+        import jax
+
+        ps = self.page_size
+        if max_len % ps:
+            raise ValueError(
+                f"paged decode needs max_len ({max_len}) to be a multiple "
+                f"of page_size ({ps})")
+        if self.window:
+            raise ValueError(
+                "paged decode does not compose with sliding-window "
+                "attention (window > 0) — the windowed span gather assumes "
+                "a dense contiguous cache row")
+        b, s, h, d = q.shape
+        hkv = k.shape[2]
+        quant = self.kv_cache_dtype == "int8"
+        store = jnp.int8 if quant else self.dtype
+
+        def _external(name):
+            def init():
+                raise ValueError(
+                    f"paged decode cache variable {name!r} must be supplied "
+                    "by the caller — the page pool is engine state; build "
+                    "it with serving.kv_pool.init_paged_cache")
+            return init
+
+        pages_k = self.variable("cache", "pages_k", _external("pages_k"))
+        pages_v = self.variable("cache", "pages_v", _external("pages_v"))
+        if quant:
+            scale_k = self.variable(
+                "cache", "pages_k_scale", _external("pages_k_scale"))
+            scale_v = self.variable(
+                "cache", "pages_v_scale", _external("pages_v_scale"))
+        bt_var = self.variable("cache", "block_table", _external("block_table"))
+        idx_var = self.variable("cache", "index", _external("index"))
+        idx = idx_var.value  # (B,) per-row decode cursor
+        bt = bt_var.value  # (B, max_len // ps) page ids into the pool
+
+        if self.rope:
+            q = apply_rope(q, offset=idx)
+            k = apply_rope(k, offset=idx)
+        # write positions, clamped like the dense path's update-slice clamp
+        pos = jnp.minimum(idx[:, None] + jnp.arange(s), max_len - 1)  # (B, S)
+        page = jnp.take_along_axis(bt, pos // ps, axis=1)  # (B, S)
+        off = pos % ps
+        if quant:
+            k_st, k_sc = quantize_kv_int8(k)
+            v_st, v_sc = quantize_kv_int8(v)
+            scale_k.value = scale_k.value.at[page, off].set(k_sc)
+            scale_v.value = scale_v.value.at[page, off].set(v_sc)
         else:
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, kc_op,
-                preferred_element_type=jnp.float32) * scale
-            if quant:
-                scores = scores * ksc.transpose(0, 2, 1)[:, :, None, :]
-            scores = jnp.where(mask[:, None], scores, -1e30)
-            p = jax.nn.softmax(scores, axis=-1)
-            if quant:
-                p = p * vsc.transpose(0, 2, 1)[:, :, None, :]
-            out = jnp.einsum(
-                "bhqk,bkhd->bqhd", p.astype(self.dtype), vc_op,
-                preferred_element_type=jnp.float32)
-        return out.astype(self.dtype)
+            k_st, v_st = k.astype(store), v.astype(store)
+        pages_k.value = pages_k.value.at[page, off].set(k_st)
+        pages_v.value = pages_v.value.at[page, off].set(v_st)
+        q_pos = idx[:, None] + jnp.arange(s)  # (B, S), unclamped (dense parity)
+        idx_var.value = jnp.minimum(idx + s, max_len)
+
+        # gather the virtual row: (n_pages, ps, ...)[bt] -> (B, n_row, ps, ...)
+        kc = pages_k.value[bt].reshape(b, max_len, hkv, d)
+        vc = pages_v.value[bt].reshape(b, max_len, hkv, d)
+        ksc = scale_k.value[bt].reshape(b, max_len, hkv) if quant else None
+        vsc = scale_v.value[bt].reshape(b, max_len, hkv) if quant else None
+        k_pos = jnp.arange(max_len)[None]
+        mask = k_pos[:, None, :] <= q_pos[:, :, None]  # (B, S, max_len)
+        return _attend_cached(q, kc, vc, ksc, vsc, mask, self.dtype)
 
 
 class StackedBlocks(nn.Module):

@@ -12,6 +12,12 @@ Mesh-TensorFlow separation of device program from execution driver
   bounded FIFO admission with prompt-length bucketing and deadlines
 * :class:`~.prefix_cache.PrefixCache` — content-addressed byte-bounded LRU
   of prefill results; repeated prompt prefixes skip prefill entirely
+* :class:`~.kv_pool.KVPagePool` — paged KV cache (ISSUE 7,
+  ``kv_page_size=``): per-layer page pools + per-slot block tables, so HBM
+  scales with live tokens, not ``slots * max_len``
+* :class:`~.radix_cache.RadixCache` — radix trie over token blocks:
+  refcounted prompt-prefix pages shared between requests (the exact-match
+  prefix cache's generalization; partial hits skip prefill compute)
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
   slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
   compile accounting (``n_compiled_programs`` — ISSUE 6), emitted through
@@ -31,7 +37,13 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.engine import (
     EngineStalled,
     InferenceEngine,
 )
+from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
+    KVPagePool,
+    init_paged_cache,
+    pages_needed,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
+from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     FIFOScheduler,
     QueueFull,
@@ -43,8 +55,12 @@ __all__ = [
     "EngineStalled",
     "InferenceEngine",
     "FIFOScheduler",
+    "KVPagePool",
     "PrefixCache",
     "QueueFull",
+    "RadixCache",
     "Request",
     "ServingStats",
+    "init_paged_cache",
+    "pages_needed",
 ]

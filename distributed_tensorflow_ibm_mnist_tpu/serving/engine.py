@@ -101,11 +101,26 @@ from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
     make_prefill,
 )
 from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_slots
+from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
+    KVPagePool,
+    init_paged_cache,
+    make_paged_extend,
+    make_paged_insert,
+    paged_reset,
+    pages_needed,
+    pool_page_bytes,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
+from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import FIFOScheduler, Request
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
 from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+# sentinel "row cache" _prefill_request returns for a radix partial-prefix
+# hit: nothing was dispatched — the real work (the suffix-extend program)
+# runs at LANDING, against the live trie/pool state at that moment
+_RADIX_PREFILL = object()
 
 
 class EngineStalled(RuntimeError):
@@ -126,6 +141,20 @@ class InferenceEngine:
     fused decode steps per dispatch/readback (greedy output is
     k-invariant; see the module docs for the waste trade).
     ``prefix_cache_bytes`` arms the prompt prefix cache (greedy only).
+
+    ``kv_page_size=ps`` switches the decode cache to the PAGED layout
+    (serving/kv_pool.py): a fixed pool of ``kv_pages`` pages per layer plus
+    per-slot block tables, so HBM scales with LIVE tokens instead of
+    ``slots * max_len``.  ``kv_pages`` defaults to dense-equivalent
+    capacity; set it LOWER to overcommit (more slots than worst-case
+    memory) — a request the pool momentarily cannot hold parks and retries
+    (admission stall, never corruption or failure).  ``radix_cache``
+    (default on when paged) shares whole prompt-prefix pages between
+    requests through a radix trie (serving/radix_cache.py): a matched
+    prefix skips its prefill compute (only the suffix runs, via the extend
+    program) and occupies ZERO extra pages.  Greedy paged output is
+    token-identical to the dense engine for every ``decode_ahead``.
+
     Sampling knobs mirror ``make_generator`` (greedy at ``temperature=0``;
     ``rng`` required otherwise — per-step keys are split from it).
     ``tracer=`` (utils/tracing.Tracer) records a span tree per request and
@@ -152,11 +181,14 @@ class InferenceEngine:
                  buckets: tuple[int, ...] | None = None,
                  decode_ahead: int = 1,
                  prefix_cache_bytes: int = 0,
+                 kv_page_size: int = 0, kv_pages: int = 0,
+                 radix_cache: bool | None = None,
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, writer: MetricWriter | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  stall_timeout_s: float | None = None,
+                 compile_cache_dir: str | None = None,
                  chaos=None, tracer=None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
@@ -191,6 +223,39 @@ class InferenceEngine:
                 "the prefix cache replays a stored GREEDY first token — "
                 "wiring it to a sampling engine (temperature > 0) would "
                 "silently freeze what should be a fresh sample; disable one")
+        if kv_page_size < 0 or kv_pages < 0:
+            raise ValueError(
+                f"kv_page_size/kv_pages must be >= 0 (0 = dense layout), "
+                f"got {kv_page_size}/{kv_pages}")
+        if kv_pages and not kv_page_size:
+            raise ValueError(
+                "kv_pages sizes the PAGED pool — it needs kv_page_size > 0")
+        if radix_cache and not kv_page_size:
+            raise ValueError(
+                "radix_cache shares whole KV PAGES between requests — it "
+                "needs the paged cache (kv_page_size > 0)")
+        if kv_page_size:
+            if max_len % kv_page_size:
+                raise ValueError(
+                    f"max_len ({max_len}) must be a multiple of kv_page_size "
+                    f"({kv_page_size}) so every slot's virtual span is "
+                    "exactly max_len (the paged==dense parity contract)")
+            if getattr(model, "window", 0):
+                raise ValueError(
+                    "the paged cache does not compose with sliding-window "
+                    "attention (model.window > 0) — the windowed decode "
+                    "gathers a contiguous dense span")
+        # persistent XLA compilation cache (opt-in): warm processes skip
+        # recompiling the engine's program family — the r04→r05 cold-start
+        # regression lever.  Semantics per core/trainer.resolve_compile_
+        # cache_dir ("default" = env/repo-local dir on accelerator
+        # backends, an explicit path always opts in, None = off).
+        if compile_cache_dir is not None:
+            from distributed_tensorflow_ibm_mnist_tpu.core.trainer import (
+                _enable_compile_cache,
+            )
+
+            _enable_compile_cache(compile_cache_dir)
         self.model = model
         self.params = params
         self.slots = slots
@@ -261,9 +326,35 @@ class InferenceEngine:
         # because the engine immediately reassigns self.cache and never
         # touches the donated buffer again; the PUBLIC make_decode_step
         # stays undonated (callers own their caches).
+        # paged mode decodes through the page pool: the DECODE-side
+        # programs (window, insert, reset, extend) switch to the paged
+        # layout while the prefill program family stays byte-identical
+        # (prefill never touches the cache — core/generate.make_prefill)
+        self._page_size = int(kv_page_size)
+        if kv_page_size:
+            n_row = max_len // kv_page_size
+            if not kv_pages:
+                # default: dense-equivalent capacity (+ the trash page) —
+                # overcommit is opt-in via an explicit smaller kv_pages
+                kv_pages = slots * n_row + 1
+            if kv_pages < n_row + 1:
+                raise ValueError(
+                    f"kv_pages ({kv_pages}) cannot hold one full-length "
+                    f"request: need >= max_len/kv_page_size + 1 "
+                    f"({n_row + 1}; page 0 is the reserved trash page)")
+            decode_model = model.clone(page_size=kv_page_size)
+        else:
+            decode_model = model
+        self._kv_pages = int(kv_pages)
+
         self._prefill = make_prefill(model, max_len)     # per-bucket shapes
-        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._reset = jax.jit(reset_cache_slots, donate_argnums=(0,))
+        if kv_page_size:
+            self._insert = jax.jit(
+                make_paged_insert(kv_page_size, max_len), donate_argnums=(0,))
+            self._reset = jax.jit(paged_reset, donate_argnums=(0,))
+        else:
+            self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+            self._reset = jax.jit(reset_cache_slots, donate_argnums=(0,))
 
         def _pick(logits, rng):
             if temperature == 0.0:
@@ -282,10 +373,25 @@ class InferenceEngine:
             # classic loop and the windowed loop are the same program
             # family, not two code paths that can drift
             return _decode_window_core(
-                model, params, cache, tok, active, rngs, max_len,
+                decode_model, params, cache, tok, active, rngs, max_len,
                 True, _pick, pad_id_)
 
         self._window = jax.jit(_window_impl, donate_argnums=(1,))
+
+        if kv_page_size:
+            # partial-prefix prefill: compute only the unshared suffix of a
+            # radix-matched prompt as one decode-mode chunk over the slot's
+            # block table, and pick its first token in-graph
+            _extend_impl = make_paged_extend(decode_model, max_len,
+                                             kv_page_size)
+
+            def _extend_and_pick(params, cache, slot, bt_row, suffix,
+                                 start, suffix_len, rng):
+                cache, last = _extend_impl(params, cache, slot, bt_row,
+                                           suffix, start, suffix_len)
+                return cache, _pick(last, rng)
+
+            self._extend = jax.jit(_extend_and_pick, donate_argnums=(1,))
 
         def _prefill_and_pick(params, prompt, lens, rng):
             cache, last = self._prefill(params, prompt, lens)
@@ -300,7 +406,26 @@ class InferenceEngine:
             self._rng, (self.decode_ahead,) + self._rng.shape)
 
         # --- mutable engine state ---
-        self.cache = init_cache(model, params, slots, max_len)
+        if kv_page_size:
+            self.cache = init_paged_cache(model, params, slots, max_len,
+                                          kv_page_size, kv_pages)
+            self._pool = KVPagePool(kv_pages, kv_page_size)
+            self._page_bytes = pool_page_bytes(self.cache)
+            self._radix = (
+                RadixCache(kv_page_size)
+                if (radix_cache is None or radix_cache) else None)
+            # per-slot allocation record: [private page ids, held radix
+            # nodes] — released at retirement, DEFERRED until the slot's
+            # reset dispatch (its stale block table references the pages
+            # until then; see _release_slot_alloc)
+            self._slot_alloc: list[list | None] = [None] * slots
+            self._deferred_free: list[list] = []
+        else:
+            self.cache = init_cache(model, params, slots, max_len)
+            self._pool = None
+            self._radix = None
+            self._slot_alloc = [None] * slots
+            self._deferred_free = []
         self._slot_req: list[Request | None] = [None] * slots
         self._slot_tok = np.full((slots,), self.pad_id, np.int32)
         self._tok_dev = None  # device copy of _slot_tok; None = stale
@@ -350,6 +475,10 @@ class InferenceEngine:
         model = get_model(trainer.config.model,
                           num_classes=trainer.num_classes, **clean_kwargs)
         kw.setdefault("writer", trainer.writer)
+        # inherit the run's persistent-compile-cache choice: the serving
+        # program family is exactly what a warm cache saves (satellite of
+        # ISSUE 7 — the r04→r05 cold-compile regression)
+        kw.setdefault("compile_cache_dir", trainer.config.compile_cache_dir)
         return cls(model, trainer._decode_params(), slots=slots,
                    max_len=max_len, **kw)
 
@@ -440,6 +569,7 @@ class InferenceEngine:
         req.status = status
         req.finish_t = now
         self._slot_req[slot] = None
+        self._release_slot_alloc(slot)  # paged: queue its pages for release
         self._active_dev = None  # occupancy changed; next window re-freezes
         self._tr_close(req, status=status, slot=slot, waste_steps=waste,
                        n_generated=len(req.generated))
@@ -492,6 +622,18 @@ class InferenceEngine:
             if hit is not None:
                 self._tr_instant(req, "prefix_cache_hit", bucket=req.bucket)
                 return hit[0], hit[1], True
+        if self._radix is not None and self._usable_radix_tokens(req) > 0:
+            # partial-prefix hit: skip the prefill dispatch NOW; the
+            # suffix-extend program runs at landing against the trie/pool
+            # state of that moment (the match is re-taken there — eviction
+            # may shrink it while the request is parked)
+            return _RADIX_PREFILL, None, False
+        return (*self._dense_prefill(req), False)
+
+    def _dense_prefill(self, req: Request):
+        """The bucketed B=1 prefill dispatch (+ first-token pick) — the
+        dense tail of :meth:`_prefill_request`, also the paged landing's
+        fallback when a parked radix match was evicted before landing."""
         padded = np.full((1, req.bucket), self.pad_id, np.int32)
         padded[0, : req.tokens.size] = req.tokens
         span = (self._tracer.begin("prefill", cat="serving",
@@ -506,7 +648,137 @@ class InferenceEngine:
         finally:
             if span is not None:
                 self._tracer.end(span)  # a poisoned prefill still closes it
-        return row_cache, first_tok, False
+        return row_cache, first_tok
+
+    def _usable_radix_tokens(self, req: Request, matched: int | None = None
+                             ) -> int:
+        """Whole-page radix match length usable for ``req``, capped so at
+        least ONE prompt token remains for the suffix (the extend program
+        needs a real position to pick the first token from)."""
+        if matched is None:
+            _, matched = self._radix.match(req.tokens)
+        ps = self._page_size
+        return min(matched, ((int(req.tokens.size) - 1) // ps) * ps)
+
+    def _alloc_pages(self, n: int) -> list[int] | None:
+        """``n`` pool pages, evicting unreferenced radix leaves to cover a
+        shortfall; None = genuinely dry (every page is held by a live slot
+        or a referenced prefix) — an admission STALL, never a failure."""
+        pages = self._pool.alloc(n)
+        if pages is None and self._radix is not None:
+            self._radix.evict(n - self._pool.free_count,
+                              lambda p: self._pool.free([p]))
+            pages = self._pool.alloc(n)
+        return pages
+
+    def _release_slot_alloc(self, slot: int) -> None:
+        """Queue ``slot``'s page allocation for release.  DEFERRED, not
+        immediate: the slot's stale block table still references the pages
+        until its reset dispatch lands, so the free (and any radix release
+        that makes nodes evictable) only happens at _flush_freed_pages,
+        called after the step's reset went out."""
+        alloc = self._slot_alloc[slot]
+        if alloc is not None:
+            self._slot_alloc[slot] = None
+            self._deferred_free.append(alloc)
+
+    def _flush_freed_pages(self) -> None:
+        """Apply deferred page frees / radix releases (see above)."""
+        if self._pool is None or not self._deferred_free:
+            return
+        for pages, nodes in self._deferred_free:
+            if pages:
+                self._pool.free(pages)
+            if nodes:
+                self._radix.release(nodes)
+        self._deferred_free.clear()
+
+    def _paged_land(self, req: Request, slot: int, prefilled: tuple):
+        """Land ``req`` in ``slot`` on the PAGED layout: allocate its page
+        span, install the block table, and either scatter the dense prefill
+        row (full prefill / prefix-cache hit) or run the suffix-extend
+        program over the radix-shared prefix.  Returns ``(first_token,
+        cache_hit)`` or None when the pool cannot cover the request right
+        now (the caller re-parks it — admission stall, not failure)."""
+        row_cache, first_tok, cache_hit = prefilled
+        ps = self._page_size
+        n_tok = int(req.tokens.size)
+        path: list = []
+        m_tok = 0
+        if row_cache is _RADIX_PREFILL:
+            # re-match at landing: the parked match may have been evicted
+            # (or grown) while the request waited for a slot
+            path, matched = self._radix.match(req.tokens)
+            m_tok = self._usable_radix_tokens(req, matched)
+            path = path[: m_tok // ps]
+            if not path:
+                # evaporated: plain dense prefill, WITHOUT re-firing the
+                # serving-admit chaos event (it fired at _prefill_request —
+                # one event per admission attempt, paging-invariant)
+                row_cache, first_tok = self._dense_prefill(req)
+                m_tok = 0
+        m_blocks = len(path)
+        if m_blocks:
+            # pin the matched pages before any allocation could evict them
+            self._radix.acquire(path)
+        total = pages_needed(n_tok + req.max_new, ps)
+        private = self._alloc_pages(total - m_blocks)
+        if private is None:
+            if m_blocks:
+                self._radix.release(path)
+            return None
+        # record the allocation BEFORE any dispatch: if the extend/insert
+        # (or the first-token callback downstream) raises, the failure
+        # path's _release_slot_alloc reclaims these pages
+        self._slot_alloc[slot] = [list(private), list(path)]
+        bt_row = np.zeros((self.max_len // ps,), np.int32)  # rest = TRASH
+        for j, node in enumerate(path):
+            bt_row[j] = node.page
+        for j, page in enumerate(private):
+            bt_row[m_blocks + j] = page
+        bt_dev = jnp.asarray(bt_row)
+        if m_blocks:
+            suffix = req.tokens[m_tok:]
+            sb = self.scheduler.bucket_for(suffix.size)
+            padded = np.full((1, sb), self.pad_id, np.int32)
+            padded[0, : suffix.size] = suffix
+            with self._compile.site(f"extend[b{sb}]"):
+                self.cache, first_dev = self._extend(
+                    self.params, self.cache, jnp.asarray(slot, jnp.int32),
+                    bt_dev, jnp.asarray(padded),
+                    jnp.asarray(m_tok, jnp.int32),
+                    jnp.asarray(suffix.size, jnp.int32), self._next_rng())
+            first = int(first_dev[0])
+            self.stats.radix(True, tokens=m_tok)
+            self._radix.record(True, tokens=m_tok)
+            req.radix_tokens = m_tok
+            self._tr_instant(req, "radix_hit", blocks=m_blocks, tokens=m_tok)
+        else:
+            with self._compile.site("slot_insert"):
+                self.cache = self._insert(self.cache, row_cache, bt_dev,
+                                          jnp.asarray(slot, jnp.int32))
+            first = (first_tok if isinstance(first_tok, int)
+                     else int(first_tok[0]))
+            if self._radix is not None:
+                self.stats.radix(False)
+                self._radix.record(False)
+            if self._prefix is not None and not cache_hit:
+                self._prefix.put(req.prefix_key, row_cache, first)
+        req.pages = total
+        if self._radix is not None:
+            # donate the freshly computed FULL prompt blocks below the
+            # match: they move from this request's private allocation into
+            # the trie (held — ref stays up until this slot retires)
+            donate = {j: int(bt_row[j])
+                      for j in range(m_blocks, n_tok // ps)}
+            if donate:
+                priv, nodes = self._slot_alloc[slot]
+                held, _kept = self._radix.insert(
+                    req.tokens, m_blocks, donate, path)
+                for node in held:
+                    priv.remove(node.page)
+                    nodes.append(node)
+        return first, cache_hit
 
     def _admit(self, req: Request, slot: int, now: float,
                prefilled: tuple | None = None) -> bool:
@@ -531,17 +803,28 @@ class InferenceEngine:
         try:
             if prefilled is None:
                 prefilled = self._prefill_request(req)
-            row_cache, first_tok, cache_hit = prefilled
-            with self._compile.site("slot_insert"):
-                self.cache = self._insert(
-                    self.cache, row_cache, jnp.asarray(slot, jnp.int32))
-            inserted = True
-            # a cache hit stored the host int; a fresh prefill syncs here
-            first = first_tok if isinstance(first_tok, int) else int(first_tok[0])
-            if self._prefix is not None and not cache_hit:
-                # insert does not donate row_cache, so the row stays valid
-                # to replay for every later identical (bucket, prompt)
-                self._prefix.put(req.prefix_key, row_cache, first)
+            if self._pool is not None:
+                landed = self._paged_land(req, slot, prefilled)
+                if landed is None:
+                    # pool momentarily full — NOT a failure: the caller
+                    # re-parks the (already chaos'd, maybe prefilled)
+                    # request and retries once decode frees pages
+                    return ("stall", prefilled)
+                first, cache_hit = landed
+                inserted = True
+            else:
+                row_cache, first_tok, cache_hit = prefilled
+                with self._compile.site("slot_insert"):
+                    self.cache = self._insert(
+                        self.cache, row_cache, jnp.asarray(slot, jnp.int32))
+                inserted = True
+                # a cache hit stored the host int; a fresh prefill syncs here
+                first = (first_tok if isinstance(first_tok, int)
+                         else int(first_tok[0]))
+                if self._prefix is not None and not cache_hit:
+                    # insert does not donate row_cache, so the row stays
+                    # valid to replay for every later identical prompt
+                    self._prefix.put(req.prefix_key, row_cache, first)
             req.admit_t = now
             req.generated.append(first)
             req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
@@ -550,6 +833,9 @@ class InferenceEngine:
                              cache_hit=cache_hit)
             self._notify(req, first)
         except Exception as e:
+            # a paged landing that allocated before raising gives its
+            # pages back (deferred past the caller's reset dispatch)
+            self._release_slot_alloc(slot)
             self._fail(req, e, self.clock())
             return inserted
         self._slot_req[slot] = req
@@ -599,6 +885,13 @@ class InferenceEngine:
                     if req is None:
                         return admitted
                     needs_reset = self._admit(req, slot, self.clock())
+                if isinstance(needs_reset, tuple):
+                    # paged pool momentarily dry ("stall", prefilled): park
+                    # the request at the FRONT (FIFO preserved — it was
+                    # popped first) and stop admitting; this step's retires
+                    # flush pages and the next iteration retries
+                    self._pending.appendleft((req, needs_reset[1]))
+                    return admitted
                 if self._slot_req[slot] is not None:
                     admitted = True
                     reset_mask[slot] = False  # insert fully overwrote the row
@@ -754,6 +1047,7 @@ class InferenceEngine:
                             # the callback's failure is THIS request's
                             # failure; its remaining window tokens die with it
                             self._slot_req[slot] = None
+                            self._release_slot_alloc(slot)
                             self._active_dev = None
                             self._fail(req, e, now)
                             reset_mask[slot] = True
@@ -788,9 +1082,17 @@ class InferenceEngine:
         if reset_mask.any():
             with self._compile.site("slot_reset"):
                 self.cache = self._reset(self.cache, jnp.asarray(reset_mask))
+        # deferred page frees apply only now, AFTER the reset dispatch is
+        # enqueued: single-stream device execution guarantees every program
+        # still reading a retired slot's block table runs before any later
+        # tenant of the reallocated pages writes them
+        self._flush_freed_pages()
 
         if produced > 0 or admitted or self.occupied == 0:
             self._last_progress_t = self.clock()
+        if self._pool is not None:
+            self.stats.pool_sample(self._pool.allocated, self._pool.capacity,
+                                   self._page_size, self._page_bytes)
         self.stats.tick(self.occupied, max(self.clock() - t0, 0.0),
                         decoded=decoded)
         # counters only at their change points (admission shrinks the
@@ -810,10 +1112,12 @@ class InferenceEngine:
             if req is None:
                 continue
             self._slot_req[slot] = None
+            self._release_slot_alloc(slot)
             self._fail(req, exc, now)
             mask[slot] = True
         if mask.any():
             self.cache = self._reset(self.cache, jnp.asarray(mask))
+        self._flush_freed_pages()
         self._active_dev = None
         self._last_progress_t = None
 
@@ -834,6 +1138,8 @@ class InferenceEngine:
             self.stats.add(req)
         self.scheduler.cancelled.clear()
         if not self.has_work:
+            if self._prefix is not None:
+                self.stats.prefix_oversized(self._prefix.oversized)
             self.stats.set_compile(CompileTracker.delta(
                 self._compile.snapshot(), self._compile0))
             if self.writer is not None:
@@ -871,6 +1177,7 @@ class InferenceEngine:
             mask[slot] = True
         if mask.any():
             self.cache = self._reset(self.cache, jnp.asarray(mask))
+        self._flush_freed_pages()
         for req, _prefilled in self._pending:  # overlap-prefilled, unlanded
             req.status = "cancelled"
             req.finish_t = now
@@ -888,6 +1195,11 @@ class InferenceEngine:
             self.completed.append(req)
             self.stats.add(req)
         self.scheduler.cancelled.clear()
+        if self._prefix is not None:
+            self.stats.prefix_oversized(self._prefix.oversized)
+        if self._pool is not None:  # final occupancy (post-cancel flush)
+            self.stats.pool_sample(self._pool.allocated, self._pool.capacity,
+                                   self._page_size, self._page_bytes)
         self.stats.set_compile(CompileTracker.delta(
             self._compile.snapshot(), self._compile0))
         if self.writer is not None:

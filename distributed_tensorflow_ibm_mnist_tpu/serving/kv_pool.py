@@ -1,0 +1,300 @@
+"""Paged KV-cache pool: the serving-side half of the paged decode path.
+
+The dense engine allocates ``slots * max_len`` cache positions up front, so
+concurrency is capped by worst-case-length allocation even when every live
+request is short.  This module re-blocks the cache into a fixed pool of
+``page_size``-token pages per layer — ``(n_pages, page_size, H_kv, D)``
+pytree leaves — plus a per-slot ``(max_len / page_size,)`` BLOCK TABLE
+mapping each row's virtual positions to pool pages.  Memory then scales
+with LIVE tokens: admission allocates ``ceil((len + max_new) / page_size)``
+pages, retirement frees them, and the engine can run more slots than the
+pool could hold at worst case (overcommit), stalling admission — never
+corrupting — when the pool is momentarily full.
+
+Layout contract (mirrors the dense cache per block name):
+
+    dense   {"k": (B, max_len, hkv, d), "v": ..., ["k_scale"/"v_scale":
+             (B, max_len, hkv)], "index": (B,)}
+    paged   {"pages_k": (n_pages, ps, hkv, d), "pages_v": ...,
+             ["pages_k_scale"/"pages_v_scale": (n_pages, ps, hkv)],
+             "block_table": (B, max_len // ps) int32, "index": (B,)}
+
+Page 0 is a reserved TRASH page: every unallocated block-table entry points
+at it, so idle rows' decode writes land in garbage nobody reads (the model's
+causal mask only exposes positions below a live row's cursor, all of which
+lie in allocated pages).  ``KVPagePool`` is the host-side allocator over
+pages ``1 .. n_pages-1``; page ids are shared across layers (page ``p``
+means slab ``p`` in EVERY layer's pool), which is what lets the radix
+prefix cache (serving/radix_cache.py) refcount a whole-model prefix block
+as one integer.
+
+Everything jitted here is donation-friendly: the engine wraps
+``make_paged_insert``/``paged_reset``/``make_paged_extend`` in ``jax.jit``
+with the cache donated, same as the dense path (the ~23% donation win from
+PR 2 carries over — the pool is the dominant buffer either way).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+TRASH_PAGE = 0
+
+
+def pages_needed(n_tokens: int, page_size: int) -> int:
+    """Pages to hold ``n_tokens`` cache positions (host-side ceil div)."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+class KVPagePool:
+    """Host-side page allocator over a pool of ``n_pages`` pages.
+
+    Page 0 is the reserved trash page and is never handed out.  ``alloc``
+    is all-or-nothing (a partially admitted request would deadlock the
+    pool) and hands out the lowest free ids first — deterministic, so the
+    paged engine's behaviour replays exactly under the fault-injection
+    harness.  The allocator knows nothing about sharing: the radix cache
+    owns refcounts and calls ``free`` only when a page's count reaches
+    zero.
+    """
+
+    def __init__(self, n_pages: int, page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if n_pages < 2:
+            raise ValueError(
+                f"n_pages must be >= 2 (page 0 is the reserved trash page), "
+                f"got {n_pages}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        # pop() takes from the END: store descending so allocation walks
+        # ascending page ids (determinism + readable block tables)
+        self._free = list(range(self.n_pages - 1, 0, -1))
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the trash page excluded)."""
+        return self.n_pages - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` pages, or None (and take nothing) if fewer are free."""
+        if n < 0:
+            raise ValueError(f"alloc needs n >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages) -> None:
+        """Return pages to the pool.  Accepts any iterable of page ids."""
+        for p in pages:
+            p = int(p)
+            if not 0 < p < self.n_pages:
+                raise ValueError(
+                    f"free of invalid page id {p} (pool has pages 1.."
+                    f"{self.n_pages - 1}; page 0 is reserved)")
+            self._free.append(p)
+        if len(self._free) > self.capacity:
+            raise ValueError("double free: more pages freed than exist")
+
+
+def init_paged_cache(model, params, slots: int, max_len: int,
+                     page_size: int, n_pages: int):
+    """A zeroed paged decode cache for ``model``: per-layer page pools
+    sized ``n_pages`` plus per-slot block tables and cursors, derived from
+    the DENSE decode layout via ``jax.eval_shape`` (no forward runs), so
+    dtypes — including the int8 payload + f32 scale split — always match
+    what the dense path would have stored.
+
+    ``model`` may be the dense model or its paged clone; the dense layout
+    is probed either way.  Every block table starts all-TRASH (page 0) and
+    every cursor at 0 — the state ``paged_reset`` restores per slot.
+    """
+    if max_len % page_size:
+        raise ValueError(
+            f"max_len ({max_len}) must be a multiple of page_size "
+            f"({page_size}) so each slot's virtual span is exactly max_len")
+    if n_pages < 2:
+        raise ValueError(f"n_pages must be >= 2, got {n_pages}")
+    dense = model.clone(page_size=0) if getattr(model, "page_size", 0) else model
+    shapes = jax.eval_shape(
+        lambda p: dense.apply(
+            {"params": p}, jnp.zeros((slots, 1), jnp.int32),
+            decode=True, max_len=max_len, ragged=True, mutable=["cache"],
+        )[1]["cache"],
+        params,
+    )
+    n_row = max_len // page_size
+    cache = {}
+    for name, entry in shapes.items():
+        k = entry["k"]  # (slots, max_len, hkv, d)
+        hkv, d = k.shape[2], k.shape[3]
+        paged = {
+            "pages_k": jnp.zeros((n_pages, page_size, hkv, d), k.dtype),
+            "pages_v": jnp.zeros((n_pages, page_size, hkv, d),
+                                 entry["v"].dtype),
+            "block_table": jnp.zeros((slots, n_row), jnp.int32),
+            "index": jnp.zeros((slots,), jnp.int32),
+        }
+        if "k_scale" in entry:
+            paged["pages_k_scale"] = jnp.zeros(
+                (n_pages, page_size, hkv), entry["k_scale"].dtype)
+            paged["pages_v_scale"] = jnp.zeros(
+                (n_pages, page_size, hkv), entry["v_scale"].dtype)
+        cache[name] = paged
+    return cache
+
+
+def pool_page_bytes(cache) -> int:
+    """Bytes one page occupies across every layer's pool leaves — the
+    ``kv_bytes_live = pages_live * pool_page_bytes`` accounting unit."""
+    total = 0
+    for entry in cache.values():
+        for key, leaf in entry.items():
+            if key.startswith("pages_"):
+                total += leaf.nbytes // leaf.shape[0]
+    return total
+
+
+def make_paged_insert(page_size: int, max_len: int) -> Callable:
+    """Build ``insert(cache, row_cache, bt_row, slot) -> cache``: scatter a
+    dense prefilled B=1 row (make_prefill's layout) into the page pool
+    through ``bt_row`` and install the row's block table + cursor at
+    ``slot``.  The engine jits this with the cache donated.
+
+    The full (max_len,) row is scattered — including garbage above the
+    cursor — which is safe precisely because a dense-prefilled request owns
+    ALL of its pages privately (pages become shared only by donation to the
+    radix trie AFTER insert, and donated pages are read-only from then on:
+    later tenants of the same prefix never write below their cursor).
+    """
+    n_row = max_len // page_size
+    pos = jnp.arange(max_len)
+    page_idx = pos // page_size
+    off = pos % page_size
+
+    def insert(cache, row_cache, bt_row, slot):
+        page = bt_row[page_idx]  # (max_len,) destination pages
+        out = {}
+        for name, entry in cache.items():
+            row = row_cache[name]
+            e = dict(entry)
+            e["pages_k"] = entry["pages_k"].at[page, off].set(
+                row["k"][0].astype(entry["pages_k"].dtype))
+            e["pages_v"] = entry["pages_v"].at[page, off].set(
+                row["v"][0].astype(entry["pages_v"].dtype))
+            if "pages_k_scale" in entry:
+                e["pages_k_scale"] = entry["pages_k_scale"].at[page, off].set(
+                    row["k_scale"][0].astype(entry["pages_k_scale"].dtype))
+                e["pages_v_scale"] = entry["pages_v_scale"].at[page, off].set(
+                    row["v_scale"][0].astype(entry["pages_v_scale"].dtype))
+            e["block_table"] = jax.lax.dynamic_update_slice(
+                entry["block_table"], bt_row[None].astype(jnp.int32),
+                (slot, 0))
+            e["index"] = jax.lax.dynamic_update_slice(
+                entry["index"], row["index"].astype(entry["index"].dtype),
+                (slot,))
+            out[name] = e
+        return out
+
+    return insert
+
+
+def paged_reset(cache, slot_mask):
+    """Per-slot reset in the paged layout: point the masked slots' block
+    tables back at the trash page and zero their cursors.  The POOL is
+    untouched — a freed page's stale K/V is dead data (nothing maps to it)
+    until the allocator hands the page to a new tenant, whose insert/extend
+    scatter overwrites every position its mask will ever expose.  The
+    paged sibling of models/transformer.py ``reset_cache_slots``; the
+    engine jits it with the cache donated under the same compile site.
+    """
+    mask = jnp.asarray(slot_mask, bool)
+    out = {}
+    for name, entry in cache.items():
+        e = dict(entry)
+        e["block_table"] = jnp.where(
+            mask[:, None], TRASH_PAGE, entry["block_table"])
+        e["index"] = jnp.where(mask, 0, entry["index"])
+        out[name] = e
+    return out
+
+
+def make_paged_extend(model, max_len: int, page_size: int) -> Callable:
+    """Build the PARTIAL-PREFIX prefill program: ``extend(params, cache,
+    slot, bt_row, suffix, start, suffix_len) -> (cache, last_logits)``.
+
+    When the radix cache matches the first ``start`` tokens of a prompt
+    (whole shared pages), only the unshared suffix needs computing.  The
+    suffix runs as ONE decode-mode chunk over the slot's block table: its
+    queries attend the shared pages (read-only) plus themselves, and its
+    K/V scatter into the slot's PRIVATE pages — copy-on-write at the
+    divergence page falls out of the layout, because the block table remaps
+    the diverging virtual block to a private page and the shared page is
+    never written.  ``suffix`` is (1, Sb) right-padded to a bucket length;
+    positions above ``suffix_len`` write garbage above the cursor into
+    private pages (masked, later overwritten by decode).  The cursor is set
+    to ``start + suffix_len`` (the REAL length, not the padded one) and
+    ``last_logits`` is (1, V) at the last real suffix position — pick the
+    first generated token from it, exactly like a dense prefill.
+
+    ``model`` must be the PAGED clone (``page_size > 0``).  The engine jits
+    this with the cache donated.
+    """
+    if not getattr(model, "page_size", 0):
+        raise ValueError(
+            "make_paged_extend needs the paged model clone "
+            "(model.page_size > 0) — it decodes through the page pool")
+    n_row = max_len // page_size
+
+    def extend(params, cache, slot, bt_row, suffix, start, suffix_len):
+        # install the row's block table first: the chunk decodes through it
+        cache = {
+            name: {
+                **e,
+                "block_table": jax.lax.dynamic_update_slice(
+                    e["block_table"], bt_row[None].astype(jnp.int32),
+                    (slot, 0)),
+            }
+            for name, e in cache.items()
+        }
+        # B=1 sub-cache over the FULL pool: only the slot's table row and
+        # cursor narrow to the row; the pool leaves are shared storage
+        sub = {}
+        for name, e in cache.items():
+            se = {k: v for k, v in e.items() if k.startswith("pages_")}
+            se["block_table"] = jax.lax.dynamic_slice(
+                e["block_table"], (slot, 0), (1, n_row))
+            se["index"] = jnp.zeros((1,), jnp.int32) + start
+            sub[name] = se
+        logits, vars_ = model.apply(
+            {"params": params, "cache": sub}, suffix.astype(jnp.int32),
+            decode=True, max_len=max_len, ragged=True, mutable=["cache"],
+        )
+        new = vars_["cache"]
+        out = {}
+        for name, e in cache.items():
+            oe = dict(e)
+            for key in e:
+                if key.startswith("pages_"):
+                    oe[key] = new[name][key]
+            # real cursor, not the padded chunk's clamped one
+            oe["index"] = e["index"].at[slot].set(
+                (start + suffix_len).astype(jnp.int32))
+            out[name] = oe
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], suffix_len - 1, axis=0, keepdims=False)  # (V,)
+        return out, last[None]
+
+    return extend

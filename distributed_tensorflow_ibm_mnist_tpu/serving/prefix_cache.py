@@ -66,6 +66,9 @@ class PrefixCache:
         self.bytes = 0
         self.hits = 0
         self.misses = 0
+        self.oversized = 0  # put() refusals: single entry > max_bytes —
+        #   a persistently nonzero count means the budget is sized below
+        #   one long-bucket row and the cache can never help that bucket
         # key -> (row_cache, first_token, entry_bytes); insertion order IS
         # recency order (move_to_end on hit)
         self._entries: OrderedDict[str, tuple] = OrderedDict()
@@ -86,13 +89,14 @@ class PrefixCache:
     def put(self, key: str, row_cache, first_token: int) -> None:
         """Store one prefill result, evicting least-recently-used entries
         until the byte budget holds.  An entry larger than the whole
-        budget is refused outright (caching it would just evict
-        everything and then itself next time)."""
+        budget is refused outright and counted (``oversized``) — storing
+        it would drain the entire LRU only to miss again next time."""
         if key in self._entries:
             self._entries.move_to_end(key)
             return
         nbytes = int(sum(leaf.nbytes for leaf in jax.tree.leaves(row_cache)))
         if nbytes > self.max_bytes:
+            self.oversized += 1
             return
         self._entries[key] = (row_cache, int(first_token), nbytes)
         self.bytes += nbytes

@@ -71,6 +71,11 @@ class Request:
     prefix_key: str | None = None       # blake2b content address of the
     #   (bucket, prompt) pair — the prefix-cache lookup key
     #   (serving/prefix_cache.py); filled by the scheduler at submit
+    pages: int = 0                      # paged engine: KV pages this
+    #   request's block table spans (shared radix pages included); 0 on
+    #   the dense layout — the per-request HBM footprint record
+    radix_tokens: int = 0               # paged engine: prompt tokens served
+    #   from shared radix pages (prefill skipped for them); 0 = full prefill
     trace: dict | None = None           # tracing bookkeeping (utils/tracing):
     #   {"id": request span, "tid": the request's track, "phase": the open
     #   lifecycle-phase span (queue/admit/decode) or None}; None when no

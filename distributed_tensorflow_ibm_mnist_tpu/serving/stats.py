@@ -75,6 +75,19 @@ class ServingStats:
         self._waste_steps = 0      # of those, discarded post-retirement
         self._prefix_hits = 0
         self._prefix_misses = 0
+        self._prefix_oversized = 0
+        # --- paged KV pool + radix prefix accounting (ISSUE 7) --- the
+        # engine samples pool occupancy each step (pool_sample) and records
+        # each admission's radix-match outcome (radix); all zero/None for
+        # dense engines, so the schema stays stable across layouts
+        self._kv_page_size = 0
+        self._kv_pages_total = 0
+        self._kv_pages_live = 0
+        self._kv_pages_peak = 0
+        self._kv_page_bytes = 0
+        self._radix_hits = 0
+        self._radix_misses = 0
+        self._radix_hit_tokens = 0
         # --- compile accounting (ISSUE 6) --- the engine's own XLA
         # program family: a CompileTracker snapshot DELTA from engine
         # construction to stats emission (utils/tracing.py)
@@ -103,6 +116,31 @@ class ServingStats:
             self._prefix_hits += 1
         else:
             self._prefix_misses += 1
+
+    def prefix_oversized(self, count: int) -> None:
+        """Absolute count of PrefixCache.put refusals (entry > max_bytes);
+        the engine copies the cache's own counter at emission time."""
+        self._prefix_oversized = int(count)
+
+    def pool_sample(self, pages_live: int, pages_total: int,
+                    page_size: int, page_bytes: int) -> None:
+        """One page-pool occupancy sample (the paged engine calls this per
+        step): live/total allocatable pages, the page size in tokens, and
+        the cross-layer bytes one page occupies (kv_pool.pool_page_bytes)."""
+        self._kv_pages_live = int(pages_live)
+        self._kv_pages_peak = max(self._kv_pages_peak, int(pages_live))
+        self._kv_pages_total = int(pages_total)
+        self._kv_page_size = int(page_size)
+        self._kv_page_bytes = int(page_bytes)
+
+    def radix(self, hit: bool, tokens: int = 0) -> None:
+        """One admission's radix-trie match outcome: ``tokens`` = shared
+        prefix length whose prefill was skipped (whole pages only)."""
+        if hit:
+            self._radix_hits += 1
+            self._radix_hit_tokens += int(tokens)
+        else:
+            self._radix_misses += 1
 
     def set_compile(self, delta: dict) -> None:
         """Record the engine's compile accounting — a
@@ -167,6 +205,23 @@ class ServingStats:
                 round(self._prefix_hits
                       / (self._prefix_hits + self._prefix_misses), 4)
                 if (self._prefix_hits + self._prefix_misses) > 0 else None
+            ),
+            "prefix_oversized": self._prefix_oversized,
+            # paged KV pool (all-zero/None on dense engines)
+            "kv_page_size": self._kv_page_size or None,
+            "kv_pages_total": self._kv_pages_total,
+            "kv_pages_live": self._kv_pages_live,
+            "kv_pages_peak": self._kv_pages_peak,
+            "kv_bytes_live": self._kv_pages_live * self._kv_page_bytes,
+            "kv_bytes_peak": self._kv_pages_peak * self._kv_page_bytes,
+            # radix prefix sharing (partial-prefix prefill skips)
+            "radix_hits": self._radix_hits,
+            "radix_misses": self._radix_misses,
+            "radix_hit_tokens": self._radix_hit_tokens,
+            "radix_hit_rate": (
+                round(self._radix_hits
+                      / (self._radix_hits + self._radix_misses), 4)
+                if (self._radix_hits + self._radix_misses) > 0 else None
             ),
             # compile accounting (None until set_compile — an engine that
             # never emitted stats has no delta to report)

@@ -1,0 +1,169 @@
+"""Paged KV cache vs dense: concurrent sessions at a FIXED HBM budget.
+
+The dense engine's concurrency is an allocation statement: every slot owns
+``max_len`` cache positions whether the request uses them or not, so a
+given KV budget buys exactly ``budget / (max_len * token_bytes)`` slots.
+The paged engine (ISSUE 7) spends the SAME bytes as a page pool plus
+per-slot block tables, so concurrency is bounded by LIVE tokens instead —
+and a shared-system-prompt workload (the common serving shape: one long
+instruction preamble, short per-user tails) shrinks live tokens further
+because the radix cache stores the shared prefix's pages ONCE.
+
+This bench pins that claim with a controlled experiment:
+
+* **dense** — ``slots_dense`` slots at ``max_len``; its KV allocation
+  defines the HBM budget for the whole experiment.
+* **paged** — the same model with ``kv_page_size`` pages, ``kv_pages``
+  chosen so the pool's token capacity EQUALS the dense allocation
+  (``slots_dense * max_len / page_size`` pages + the reserved trash
+  page), radix prefix sharing on, and 4x the slot count — the pool, not
+  the slot array, is the limiting resource (overcommit: admission stalls
+  when the pool is dry, which is the memory model under test).
+
+Both legs serve the identical stream — ``n_requests`` prompts that share
+one ``shared_len``-token system prefix and diverge into unique tails —
+and the harness refuses to report a win unless the paged outputs are
+token-identical to dense (greedy decode; slot count and paging must not
+change a single token).  Peak CONCURRENT sessions is sampled after every
+host step; the headline ``concurrency_ratio`` is paged peak / dense peak
+at equal bytes, and the acceptance gate is >= 2x.
+
+Run in a subprocess by bench.py or directly::
+
+    JAX_PLATFORMS=cpu python scripts/bench_kv_paging.py
+
+Prints ONE JSON line (``"metric": "kv_paging"``).  ``DTM_BENCH_QUICK=1``
+shrinks the model/stream to a CI smoke of the same code paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+QUICK = os.environ.get("DTM_BENCH_QUICK", "") not in ("", "0")
+
+# model (FLOPs are not the point here; the memory model is)
+VOCAB = 64 if QUICK else 256
+DIM = 48 if QUICK else 128
+DEPTH = 2 if QUICK else 3
+HEADS = 4
+
+# the experiment's geometry
+MAX_LEN = 128
+PAGE_SIZE = 16
+SLOTS_DENSE = 4
+SLOTS_PAGED = 16
+SHARED_LEN = 48          # system prompt: 3 full shared pages
+TAIL_LEN = 8             # unique per-user tail
+MAX_NEW = 8 if QUICK else 16
+N_REQUESTS = 12 if QUICK else 32
+# equal token capacity: dense slots*max_len positions, re-cut into pages
+KV_PAGES = SLOTS_DENSE * MAX_LEN // PAGE_SIZE + 1  # +1: reserved trash page
+
+
+def build_engine(**kw):
+    from distributed_tensorflow_ibm_mnist_tpu.models.causal_lm import CausalLM
+    from distributed_tensorflow_ibm_mnist_tpu.serving import InferenceEngine
+
+    model = CausalLM(num_classes=VOCAB, dim=DIM, depth=DEPTH, heads=HEADS,
+                     dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return InferenceEngine(model, params, max_len=MAX_LEN,
+                           buckets=(64, 128), eos_id=None, **kw)
+
+
+def make_prompts():
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, VOCAB, size=SHARED_LEN).tolist()
+    return [shared + rng.integers(1, VOCAB, size=TAIL_LEN).tolist()
+            for _ in range(N_REQUESTS)]
+
+
+def kv_bytes(engine) -> int:
+    """Total decode-cache bytes (pool/rows + tables + cursors) — the HBM
+    figure the budget comparison is made in."""
+    return int(sum(leaf.nbytes for leaf in jax.tree.leaves(engine.cache)))
+
+
+def serve(engine, prompts):
+    """Serve the stream with a manual step loop, sampling live sessions
+    (occupied slots) after every host step.  Returns (outputs, peak
+    concurrency, wall seconds, stats summary)."""
+    reqs = [engine.submit(p, max_new=MAX_NEW) for p in prompts]
+    peak = 0
+    t0 = time.perf_counter()
+    while engine.has_work:
+        engine.step()
+        live = sum(1 for r in engine._slot_req if r is not None)
+        peak = max(peak, live)
+    wall = time.perf_counter() - t0
+    assert all(r.status == "done" for r in reqs), \
+        [r.status for r in reqs if r.status != "done"]
+    return [tuple(r.generated) for r in reqs], peak, wall, engine.stats.summary()
+
+
+def main() -> int:
+    prompts = make_prompts()
+
+    dense_eng = build_engine(slots=SLOTS_DENSE)
+    dense_bytes = kv_bytes(dense_eng)
+    dense_out, dense_peak, dense_wall, dense_stats = serve(dense_eng, prompts)
+
+    paged_eng = build_engine(slots=SLOTS_PAGED, kv_page_size=PAGE_SIZE,
+                             kv_pages=KV_PAGES)
+    paged_bytes = kv_bytes(paged_eng)
+    paged_out, paged_peak, paged_wall, paged_stats = serve(paged_eng, prompts)
+
+    outputs_match = paged_out == dense_out
+    ratio = paged_peak / dense_peak if dense_peak else 0.0
+    useful = N_REQUESTS * MAX_NEW
+    record = {
+        "metric": "kv_paging",
+        "quick": QUICK,
+        "model": {"dim": DIM, "depth": DEPTH, "heads": HEADS, "vocab": VOCAB},
+        "workload": {
+            "requests": N_REQUESTS, "shared_prefix_tokens": SHARED_LEN,
+            "tail_tokens": TAIL_LEN, "max_new": MAX_NEW,
+        },
+        "geometry": {
+            "max_len": MAX_LEN, "page_size": PAGE_SIZE,
+            "slots_dense": SLOTS_DENSE, "slots_paged": SLOTS_PAGED,
+            "kv_pages": KV_PAGES,
+        },
+        "dense": {
+            "kv_bytes": dense_bytes, "peak_concurrency": dense_peak,
+            "wall_s": round(dense_wall, 4),
+            "tok_per_s": round(useful / dense_wall, 1),
+        },
+        "paged": {
+            "kv_bytes": paged_bytes, "peak_concurrency": paged_peak,
+            "wall_s": round(paged_wall, 4),
+            "tok_per_s": round(useful / paged_wall, 1),
+            "kv_pages_peak": paged_stats["kv_pages_peak"],
+            "kv_pages_total": paged_stats["kv_pages_total"],
+            "radix_hits": paged_stats["radix_hits"],
+            "radix_hit_tokens": paged_stats["radix_hit_tokens"],
+        },
+        "bytes_ratio": round(paged_bytes / dense_bytes, 4),
+        "concurrency_ratio": round(ratio, 2),
+        "outputs_match": outputs_match,
+        "ok": bool(outputs_match and ratio >= 2.0),
+    }
+    print(json.dumps(record))
+    return 0 if record["ok"] else 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())

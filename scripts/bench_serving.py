@@ -55,6 +55,24 @@ Two more legs (ISSUE 6, observability):
   whole decode step is ~200us of host Python and ANY per-window event
   model breaches 2% by arithmetic (see docs/OBSERVABILITY.md §Overhead).
 
+Two more legs (ISSUE 7, paged KV):
+
+* **compile_census** additionally serves a PAGED engine (``kv_page_size``
+  set, radix on, a shared-prefix pair so the extend program compiles):
+  ``paged_cold`` pins the exact program set the paged path adds (prefill,
+  paged insert, paged reset, decode window, radix extend) and
+  ``paged_repeat`` pins zero recompiles on reuse.  The census is now a
+  REGRESSION GATE: every leg's program count is pinned in
+  ``CENSUS_BUDGET`` and the bench exits nonzero (status 3) when any leg
+  exceeds its budget — a new program sneaking into the serving path fails
+  CI instead of silently inflating compile time.
+* **compile_cache** — the opt-in persistent compilation cache
+  (``compile_cache_dir=`` / ``train.py --compile-cache-dir``) measured
+  honestly: two SUBPROCESSES share a temp cache dir (an in-process rerun
+  would hit jax's in-memory jit cache and prove nothing); the cold run
+  populates the dir, the warm run must add no files, and cold-vs-warm
+  compile seconds come from each process's own CompileTracker.
+
 ``DTM_BENCH_QUICK=1`` shrinks models/streams to a CI smoke of the same
 code paths (exercised by a ``slow``-marked test so harness rot is caught
 without paying the full sweep); the record carries ``"quick": true``.
@@ -303,17 +321,37 @@ def run_prefix_cache(model, params, slots: int, repeats: int) -> dict:
     }
 
 
-def run_compile_census(slots: int) -> dict:
-    """ISSUE 6 acceptance: ``n_compiled_programs`` changes when — and only
-    when — a new prefill bucket is introduced.  ONE engine (jit caches are
-    per-engine closures) with buckets (16, 32) serves four requests in
-    sequence; the CompileTracker snapshot delta around each shows
+# Pinned per-leg budgets for the compile census (ISSUE 7 satellite: the
+# census is a regression GATE, not just a report — a leg exceeding its
+# budget means a program-family leak, and the bench exits nonzero).  The
+# numbers are the MEASURED cold sets of the current engine, pinned exact:
+# one extra program in any leg is the regression the gate exists to catch.
+CENSUS_BUDGET = {
+    "bucket16_first": 7,    # prefill[b16] (+pick) + window + insert + reset
+    #                         + 2 unattributed helper jits
+    "bucket16_repeat": 0,   # repeats compile NOTHING
+    "bucket32_new": 1,      # the new bucket's prefill only
+    "bucket32_repeat": 0,
+    "paged_cold": 5,        # paged prefill/insert/window/reset + extend
+    "paged_repeat": 0,      # paging adds programs once, not per request
+}
 
-    1. first bucket-16 request: the engine's cold set (prefill[b16],
-       decode_window, slot_insert, slot_reset) compiles;
+
+def run_compile_census(slots: int) -> dict:
+    """ISSUE 6 acceptance, hardened into a gate (ISSUE 7 satellite):
+    ``n_compiled_programs`` changes when — and only when — a new program
+    family member is introduced, and every leg stays within its pinned
+    ``CENSUS_BUDGET``.  ONE dense engine (jit caches are per-engine
+    closures) with buckets (16, 32) serves four requests in sequence, then
+    one PAGED engine (its own window/insert/reset/extend family) serves a
+    shared-prefix pair twice:
+
+    1. first bucket-16 request: the engine's cold set compiles;
     2. second bucket-16 request: ZERO new programs (all cache hits);
     3. first bucket-32 request: EXACTLY the new bucket's prefill program;
-    4. second bucket-32 request: zero again.
+    4. second bucket-32 request: zero again;
+    5. paged_cold: the paged family (+ the radix suffix-extend program);
+    6. paged_repeat: zero — paging adds programs once, not per request.
     """
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
@@ -334,30 +372,141 @@ def run_compile_census(slots: int) -> dict:
                                 max_queue=8))
     rng = np.random.default_rng(5)
 
-    def serve_one(prompt_len):
+    def serve_one(engine, prompts):
         before = tracker.snapshot()
-        prompt = rng.integers(1, VOCAB - 1, size=(prompt_len,)).astype(np.int32)
-        eng.submit(prompt, max_new=SHORT_NEW)
-        eng.run()
+        for p in prompts:
+            engine.submit(p, max_new=SHORT_NEW)
+        engine.run()
         d = CompileTracker.delta(tracker.snapshot(), before)
         return {"n_new_programs": d["n_compiled_programs"],
                 "by_site": {k: v["n"] for k, v in d["by_site"].items()}}
 
+    def rand_prompt(n):
+        return rng.integers(1, VOCAB - 1, size=(n,)).astype(np.int32)
+
     legs = {
-        "bucket16_first": serve_one(8),
-        "bucket16_repeat": serve_one(10),   # same bucket, different prompt
-        "bucket32_new": serve_one(24),
-        "bucket32_repeat": serve_one(28),
+        "bucket16_first": serve_one(eng, [rand_prompt(8)]),
+        "bucket16_repeat": serve_one(eng, [rand_prompt(10)]),  # same bucket
+        "bucket32_new": serve_one(eng, [rand_prompt(24)]),
+        "bucket32_repeat": serve_one(eng, [rand_prompt(28)]),
     }
+    # the paged program family: a fresh paged engine (page pool + radix)
+    # serving a shared-prefix pair — the second request radix-matches the
+    # first's donated page, compiling the suffix-extend program once
+    peng = InferenceEngine(
+        model, params, slots=slots, max_len=48, kv_page_size=8,
+        scheduler=FIFOScheduler(max_len=48, buckets=(16, 32), max_queue=8))
+    shared = rand_prompt(8)
+    pair = [np.concatenate([shared, rand_prompt(4)]) for _ in range(2)]
+    legs["paged_cold"] = serve_one(peng, pair)
+    legs["paged_repeat"] = serve_one(
+        peng, [np.concatenate([shared, rand_prompt(4)]) for _ in range(2)])
+    over = {name: leg["n_new_programs"] - CENSUS_BUDGET[name]
+            for name, leg in legs.items()
+            if leg["n_new_programs"] > CENSUS_BUDGET[name]}
     return {
         "legs": legs,
         "mode": tracker.mode,
+        "budget": CENSUS_BUDGET,
+        # the regression gate: any leg over its pinned budget fails the
+        # bench run (main() exits 3) — program-family growth is a perf
+        # regression even when every test still passes
+        "over_budget": over,
+        "census_ok": not over,
         # the acceptance booleans bench.py's record pins: repeats compile
         # NOTHING, and the new bucket compiles SOMETHING
         "repeat_compiles_zero": (
             legs["bucket16_repeat"]["n_new_programs"] == 0
-            and legs["bucket32_repeat"]["n_new_programs"] == 0),
+            and legs["bucket32_repeat"]["n_new_programs"] == 0
+            and legs["paged_repeat"]["n_new_programs"] == 0),
         "new_bucket_compiles": legs["bucket32_new"]["n_new_programs"] > 0,
+    }
+
+
+def _compile_cache_probe(cache_dir: str) -> None:
+    """Subprocess mode (``--compile-cache-probe DIR``): build ONE engine
+    with the persistent XLA compile cache at DIR, serve two requests, and
+    print the engine's compile accounting as JSON.  Run twice against the
+    same DIR by :func:`run_compile_cache`, the first call populates the
+    cache and the second measures what a warm process actually saves —
+    cross-PROCESS, which is the regression the cache exists to fix (an
+    in-process rerun would hit jax's in-memory jit cache and prove
+    nothing).  Uses the bench's PRIMARY model: the persistent cache only
+    stores programs above ``jax_persistent_cache_min_compile_time_secs``
+    (0.1 s — core/trainer._enable_compile_cache), and the toy models'
+    programs all compile under that floor, honestly measuring nothing."""
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+    )
+
+    max_len = 16 + SHORT_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DIM,
+                      depth=DEPTH, heads=HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(9),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    t0 = time.perf_counter()
+    eng = InferenceEngine(
+        model, params, slots=2, max_len=max_len,
+        compile_cache_dir=cache_dir,
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(16,), max_queue=4))
+    # the production threshold (0.1 s) is tuned for accelerator-scale
+    # programs; this host's XLA:CPU backend-compiles each engine program
+    # in less, which would honestly cache NOTHING — lower the floor so
+    # the probe exercises the cache mechanism itself (programs compile
+    # lazily at first dispatch, so this lands before any compile)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    rng = np.random.default_rng(11)
+    for _ in range(2):
+        eng.submit(rng.integers(1, VOCAB - 1, size=(8,)).astype(np.int32),
+                   max_new=4)
+    eng.run()
+    s = eng.stats.summary()
+    print(json.dumps({
+        "wall_s": round(time.perf_counter() - t0, 4),
+        "compile_s": s["compile_time_s"],
+        "n_programs": s["n_compiled_programs"],
+        "n_cache_files": len(os.listdir(cache_dir)),
+    }), flush=True)
+
+
+def run_compile_cache(timeout_s: float = 600.0) -> dict:
+    """ISSUE 7 satellite: cold-vs-warm compile seconds through the opt-in
+    persistent compilation cache (``compile_cache_dir=`` on the engine /
+    ``compile_cache_dir`` in RunConfig).  Two subprocess probes share one
+    ephemeral cache dir; the report is honest about the delta it actually
+    measured — ``cache_effective`` is a measurement, not an assertion
+    (CPU-backend cacheability varies across jax versions)."""
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="dtm-compile-cache-") as d:
+        runs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--compile-cache-probe", d],
+                capture_output=True, text=True, timeout=timeout_s,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            if proc.returncode != 0:
+                return {"error": (proc.stderr or proc.stdout).strip()[-400:]}
+            runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    cold, warm = runs
+    return {
+        "cold_wall_s": cold["wall_s"],
+        "warm_wall_s": warm["wall_s"],
+        # CompileTracker seconds include trace+lower (host work a cache
+        # hit still pays); the backend-compile share is what warms away
+        "cold_compile_s": cold["compile_s"],
+        "warm_compile_s": warm["compile_s"],
+        "n_programs": cold["n_programs"],
+        "n_cache_files": warm["n_cache_files"],
+        # the wiring proof: the cold probe POPULATED the dir and the warm
+        # probe added nothing (it read what the cold one wrote)
+        "cache_effective": (
+            cold["n_cache_files"] > 0
+            and warm["n_cache_files"] == cold["n_cache_files"]),
     }
 
 
@@ -456,7 +605,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--compile-cache-probe", metavar="DIR", default=None,
+                    help="internal: run one engine against the persistent "
+                         "compile cache at DIR and print its compile "
+                         "accounting (spawned by the compile_cache leg)")
     args = ap.parse_args()
+    if args.compile_cache_probe is not None:
+        _compile_cache_probe(args.compile_cache_probe)
+        return
     if QUICK:
         args.requests = min(args.requests, 10)
 
@@ -520,6 +676,7 @@ def main() -> None:
         "prefix_cache": run_prefix_cache(
             model, params, args.slots, 6 if QUICK else 12),
         "compile_census": run_compile_census(args.slots),
+        "compile_cache": run_compile_cache(),
         "tracer_overhead": run_tracer_overhead(
             args.slots, 16 if QUICK else 24),
         "quick": QUICK,
@@ -532,6 +689,13 @@ def main() -> None:
         ),
     }
     print(json.dumps(result), flush=True)
+    # the census GATE: program-family growth past the pinned budgets is a
+    # perf regression (compile storms at startup, cache-key churn) — fail
+    # the bench run so CI catches it, AFTER the record is printed
+    if not result["compile_census"]["census_ok"]:
+        print(f"compile census over budget: "
+              f"{result['compile_census']['over_budget']}", file=sys.stderr)
+        sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -478,3 +478,10 @@ def test_bench_compile_census_quick_smoke():
     for site in ("decode_window", "slot_insert", "slot_reset"):
         assert not any(k.startswith(site)
                        for k in census["bucket32_new"]["by_site"])
+    # ISSUE 7: the census is a regression GATE — every leg pinned to its
+    # budget, and the paged family compiles once, never per request
+    assert rec["census_ok"] is True, rec["over_budget"]
+    assert set(rec["budget"]) == set(census)
+    assert census["paged_cold"]["n_new_programs"] > 0
+    assert any(k.startswith("extend[") for k in census["paged_cold"]["by_site"])
+    assert census["paged_repeat"]["n_new_programs"] == 0
