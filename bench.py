@@ -377,6 +377,48 @@ def main() -> None:
 
             print(f"bench: chaos phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 7 — the router soak (ISSUE 8): 3 engine replicas behind the
+    # least-loaded router, chaos killing one replica mid-wave (failover
+    # re-dispatch, token-identical outputs, exactly-once streams), a live
+    # weight hot-swap from a training checkpoint with the first swap
+    # attempt chaos-aborted (rollout retried to completion, zero dropped
+    # requests), and cold-vs-warm replica bring-up through the persistent
+    # compile cache.  Runs scripts/router_soak.py in a SUBPROCESS on the
+    # CPU backend; the script exits nonzero when any request drops.
+    # Skippable; never sinks the headline.
+    router = None
+    if not os.environ.get("DTM_BENCH_SKIP_ROUTER"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "router_soak.py")],
+                capture_output=True, text=True, timeout=540, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "router":
+                    router = rec
+            if router is None or out.returncode != 0:
+                print(
+                    f"bench: router subprocess "
+                    f"{'produced no record' if router is None else 'FAILED (dropped requests or identity breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            print(f"bench: router phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -454,6 +496,10 @@ def main() -> None:
     if chaos is not None:
         result["chaos"] = {
             k: v for k, v in chaos.items() if k != "metric"
+        }
+    if router is not None:
+        result["router"] = {
+            k: v for k, v in router.items() if k != "metric"
         }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm

@@ -21,7 +21,15 @@ Mesh-TensorFlow separation of device program from execution driver
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
   slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
   compile accounting (``n_compiled_programs`` — ISSUE 6), emitted through
-  :class:`~..utils.metrics.MetricWriter`
+  :class:`~..utils.metrics.MetricWriter`; ``ServingStats.merge`` rolls N
+  engine records into one cluster record (ISSUE 8)
+* :class:`~.router.Router` / :class:`~.replica.Replica` /
+  :class:`~.router.WeightWatcher` — the multi-replica tier (ISSUE 8):
+  least-loaded dispatch over N engine replicas, chaos-proven failover
+  (``Request.engine_fault`` collateral re-dispatched to survivors,
+  exactly-once token delivery under greedy decode), and live weight hot
+  swap (drain → ``swap_params`` → re-admit, one replica at a time,
+  validated through ``restore_latest_intact``)
 
 Observability (ISSUE 6): pass ``tracer=`` (utils/tracing.Tracer) to the
 engine and every request records a span tree (submit → queue → admit/
@@ -44,6 +52,13 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
+from distributed_tensorflow_ibm_mnist_tpu.serving.replica import Replica
+from distributed_tensorflow_ibm_mnist_tpu.serving.router import (
+    NoHealthyReplica,
+    Router,
+    RouterRequest,
+    WeightWatcher,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     FIFOScheduler,
     QueueFull,
@@ -56,11 +71,16 @@ __all__ = [
     "InferenceEngine",
     "FIFOScheduler",
     "KVPagePool",
+    "NoHealthyReplica",
     "PrefixCache",
     "QueueFull",
     "RadixCache",
+    "Replica",
     "Request",
+    "Router",
+    "RouterRequest",
     "ServingStats",
+    "WeightWatcher",
     "init_paged_cache",
     "pages_needed",
 ]

@@ -189,7 +189,7 @@ class InferenceEngine:
                  clock: Callable[[], float] = time.monotonic,
                  stall_timeout_s: float | None = None,
                  compile_cache_dir: str | None = None,
-                 chaos=None, tracer=None):
+                 chaos=None, tracer=None, trace_tid: int = 0):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 (None disables the watchdog), "
@@ -301,6 +301,11 @@ class InferenceEngine:
                 "objects — a request's span tree would be split across two "
                 "buffers; wire ONE tracer (either side) and both will use it")
         self._tracer = tracer  # nil-guarded at every touch, like chaos
+        # the engine's host-loop track.  0 (the default "host" track) for a
+        # standalone engine; a Router gives each replica its own track
+        # (tracer.track("replica <i>")) so N engine loops sharing ONE
+        # tracer render as N lanes instead of interleaving on lane 0.
+        self._trace_tid = int(trace_tid)
         # Compile accounting is always on (the listener is process-global
         # and costs nothing between compiles): the delta between this
         # baseline and shutdown is the engine's own program family, folded
@@ -433,6 +438,11 @@ class InferenceEngine:
         # prefill-overlap parking lot: (req, (row_cache, first_tok, hit))
         # tuples prefilled against an in-flight window, awaiting a slot
         self._pending: deque[tuple] = deque()
+        # ids of parked requests whose landing STALLED on a dry page pool
+        # (overcommit): close() must FAIL these terminally (engine_fault —
+        # the engine gave up on work it had accepted) instead of the
+        # plain "cancelled" an overlap-prefilled pending gets
+        self._stalled_ids: set[int] = set()
         self._prefix = (
             PrefixCache(prefix_cache_bytes) if prefix_cache_bytes > 0
             else None)
@@ -867,6 +877,7 @@ class InferenceEngine:
             while self._slot_req[slot] is None:
                 if self._pending:
                     req, prefilled = self._pending.popleft()
+                    self._stalled_ids.discard(req.id)
                     now = self.clock()
                     if now > req.overdue_at:
                         # the overlap gamble lost: prefilled, then the
@@ -891,6 +902,7 @@ class InferenceEngine:
                     # popped first) and stop admitting; this step's retires
                     # flush pages and the next iteration retries
                     self._pending.appendleft((req, needs_reset[1]))
+                    self._stalled_ids.add(req.id)
                     return admitted
                 if self._slot_req[slot] is not None:
                     admitted = True
@@ -995,16 +1007,17 @@ class InferenceEngine:
                     # engine-track instant records it once; requests it
                     # kills get their own chaos_fault/close via _fail
                     self._tracer.instant(
-                        "decode_fault", cat="serving",
+                        "decode_fault", cat="serving", tid=self._trace_tid,
                         error=f"{type(e).__name__}: {e}")
                     wid = self._tracer.complete(
                         "window", t_w0, now, cat="serving", k=k,
-                        occupied=occupied_at_dispatch,
+                        tid=self._trace_tid, occupied=occupied_at_dispatch,
                         error=type(e).__name__)
                     if t_disp is not None:
                         self._tracer.complete(
                             "dispatch", t_disp, now, cat="serving",
-                            parent=wid, error=type(e).__name__)
+                            tid=self._trace_tid, parent=wid,
+                            error=type(e).__name__)
                 anchor = self._last_progress_t if self._last_progress_t is not None else t0
                 if self._last_progress_t is None:
                     self._last_progress_t = t0
@@ -1068,14 +1081,14 @@ class InferenceEngine:
                 if self._tracer is not None:
                     wid = self._tracer.complete(
                         "window", t_w0, self.clock(), cat="serving", k=k,
-                        occupied=occupied_at_dispatch,
+                        tid=self._trace_tid, occupied=occupied_at_dispatch,
                         produced=produced, waste=waste)
                     self._tracer.complete("dispatch", t_disp,
-                                          t_disp + dispatch_s,
-                                          cat="serving", parent=wid)
+                                          t_disp + dispatch_s, cat="serving",
+                                          tid=self._trace_tid, parent=wid)
                     self._tracer.complete("readback", t_rb,
-                                          t_rb + readback_s,
-                                          cat="serving", parent=wid)
+                                          t_rb + readback_s, cat="serving",
+                                          tid=self._trace_tid, parent=wid)
 
         # 4) zero retired rows so idle cursors restart from 0 (bounded) and
         #    the next admission starts from a clean row
@@ -1099,8 +1112,10 @@ class InferenceEngine:
         # queue, retirement frees slots) — the tracer dedups repeats
         # anyway, but the calls themselves are hot-loop cost
         if self._tracer is not None and (admitted or reset_mask.any()):
-            self._tracer.counter("queue_depth", len(self.scheduler))
-            self._tracer.counter("occupied_slots", self.occupied)
+            self._tracer.counter("queue_depth", len(self.scheduler),
+                                 tid=self._trace_tid)
+            self._tracer.counter("occupied_slots", self.occupied,
+                                 tid=self._trace_tid)
         return produced
 
     def _fail_in_flight(self, exc: BaseException, now: float) -> None:
@@ -1113,6 +1128,7 @@ class InferenceEngine:
                 continue
             self._slot_req[slot] = None
             self._release_slot_alloc(slot)
+            req.engine_fault = True  # collateral, not the request's own fault
             self._fail(req, exc, now)
             mask[slot] = True
         if mask.any():
@@ -1164,7 +1180,12 @@ class InferenceEngine:
         """Graceful shutdown, phase 2 (or an immediate one): cancel every
         queued and in-flight request (terminal ``cancelled``, partial
         output kept), emit the stats record, and refuse all further
-        submit/step/run/drain calls.  Idempotent."""
+        submit/step/run/drain calls.  A parked request whose landing
+        STALLED on a dry page pool (overcommit) is instead FAILED
+        terminally — it was accepted and then starved, not merely queued.
+        Every request terminated here carries ``engine_fault=True`` (the
+        engine quit on it; a router re-dispatches exactly these).
+        Idempotent."""
         if self._closed:
             return
         self._draining = True
@@ -1173,19 +1194,28 @@ class InferenceEngine:
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
+            req.engine_fault = True
             self._retire(slot, "cancelled", now)
             mask[slot] = True
         if mask.any():
             self.cache = self._reset(self.cache, jnp.asarray(mask))
         self._flush_freed_pages()
         for req, _prefilled in self._pending:  # overlap-prefilled, unlanded
-            req.status = "cancelled"
-            req.finish_t = now
-            self._tr_close(req, status="cancelled")
-            self.completed.append(req)
-            self.stats.add(req)
+            req.engine_fault = True
+            if req.id in self._stalled_ids:
+                self._fail(req, RuntimeError(
+                    "engine closed while the request was overcommit-stalled "
+                    "(accepted, prefilled, starved of KV pages)"), now)
+            else:
+                req.status = "cancelled"
+                req.finish_t = now
+                self._tr_close(req, status="cancelled")
+                self.completed.append(req)
+                self.stats.add(req)
         self._pending.clear()
+        self._stalled_ids.clear()
         while (req := self.scheduler.pop(now)) is not None:
+            req.engine_fault = True
             req.status = "cancelled"
             req.finish_t = now
             self._tr_close(req, status="cancelled")
@@ -1212,3 +1242,36 @@ class InferenceEngine:
     def __exit__(self, *exc) -> bool:
         self.close()
         return False
+
+    # ------------------------------------------------------------------
+    # live weight replacement
+
+    def swap_params(self, params) -> None:
+        """Replace the decode weights of an IDLE engine in place — the
+        replica half of the router's hot-swap (drain → swap → re-admit).
+
+        The engine must be fully quiesced (no occupied slot, no parked
+        pending, no queued request): every cached KV entry was computed
+        under the OLD weights, so a swap with work in flight would splice
+        old-weight keys/values into new-weight attention.  For the same
+        reason the prefix cache and the radix trie are dropped wholesale —
+        their entries are stale the instant the weights change — with the
+        trie's pages returned to the pool.  The compiled program family is
+        shape-keyed, not weight-keyed, so NO recompilation follows: the
+        swapped engine serves its first new-weight request at full speed.
+        """
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self.has_work:
+            raise RuntimeError(
+                f"swap_params on a busy engine (occupied={self.occupied}, "
+                f"pending={len(self._pending)}, queued={len(self.scheduler)})"
+                " — drain it first (stop submitting, pump step() until "
+                "has_work is False)")
+        self.params = params
+        if self._prefix is not None:
+            self._prefix.clear()
+        if self._radix is not None:
+            # every node is unreferenced on an idle engine; evict the lot
+            self._radix.evict(self._radix.n_blocks,
+                              lambda p: self._pool.free([p]))

@@ -103,3 +103,10 @@ class PrefixCache:
         while self.bytes > self.max_bytes:
             _, (_, _, nb) = self._entries.popitem(last=False)
             self.bytes -= nb
+
+    def clear(self) -> None:
+        """Drop every entry (weight hot-swap: rows prefilled under the old
+        params are wrong under the new ones).  Hit/miss counters survive —
+        they are the run's story, not the cache's contents."""
+        self._entries.clear()
+        self.bytes = 0

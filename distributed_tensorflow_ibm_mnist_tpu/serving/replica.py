@@ -1,0 +1,127 @@
+"""One engine replica under the router: lifecycle, health, load score.
+
+The router (serving/router.py) never constructs an :class:`InferenceEngine`
+directly — it holds N :class:`Replica` wrappers, each owning the engine's
+LIFECYCLE: spawn (build via the caller's factory, timed — the cold-vs-warm
+bring-up figure the persistent compile cache exists to improve), health
+state, restart after failure, and the live load score the least-loaded
+dispatch sorts by.  The split mirrors the engine/scheduler split one level
+up: the engine multiplexes requests over slots; the replica multiplexes
+ENGINES over failures and weight swaps.
+
+Health is a three-state machine, transitions owned by the router:
+
+* ``HEALTHY`` — dispatchable; pumped every router step.
+* ``DRAINING`` — pumped but NOT dispatchable: a weight hot-swap is
+  waiting for the engine to quiesce (``has_work`` to go False) while the
+  other replicas absorb the traffic.  Transient by construction.
+* ``FAILED`` — the engine raised an engine-wide fault (EngineStalled, a
+  decode fault with no watchdog) or flunked a health probe; the router
+  closed it, harvested its collateral requests for failover, and may
+  :meth:`spawn` a replacement in place.
+
+The factory (``make_engine(trace_tid)``) is the configuration seam: it
+chooses slots/paging/decode-ahead AND ``compile_cache_dir=`` — a factory
+wired to a persistent compile cache makes every respawn warm (the restarted
+replica reuses the program family the first spawn compiled, so bring-up
+drops from whole-family compile time to cache reads; ``spawn_history``
+records the difference).  The ``trace_tid`` argument is the replica's own
+timeline track: all N engines share ONE tracer, and per-replica tracks keep
+their host loops from interleaving on a single lane.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+HEALTHY = "healthy"
+DRAINING = "draining"
+FAILED = "failed"
+
+
+class Replica:
+    """Engine lifecycle wrapper: see the module docstring.
+
+    ``make_engine(trace_tid)`` must return a fresh
+    :class:`~.engine.InferenceEngine`; it is called at every (re)spawn.
+    The factory should NOT wire a per-engine ``writer=`` — the router
+    emits ONE merged cluster record (``ServingStats.merge``) instead of N
+    interleaved per-engine records.
+    """
+
+    def __init__(self, index: int, make_engine: Callable, tracer=None):
+        self.index = int(index)
+        self._make_engine = make_engine
+        self._tracer = tracer
+        # the replica's own timeline lane, stable across respawns — every
+        # engine this replica ever runs logs its host loop here
+        self.tid = tracer.track(f"replica {self.index}") if tracer is not None else 0
+        self.engine = None
+        self.state = FAILED  # nothing to serve until spawn()
+        self.spawns = 0
+        self.swaps = 0
+        # checkpoint step of the weights this replica currently serves;
+        # None = the factory's originals.  The router stamps it on every
+        # successful swap (and on restart, which re-applies the tier's
+        # current weights) — the watcher's rollout-completeness check
+        # reads it to retry replicas a chaos hit left behind
+        self.weight_step: int | None = None
+        self.spawn_s: float | None = None     # last bring-up wall seconds
+        self.spawn_history: list[float] = []  # all bring-ups (cold vs warm)
+        # ServingStats of engines this replica has already CLOSED (failure
+        # or shutdown); the router folds these + the live engine's stats
+        # into the cluster rollup
+        self.stats_records: list = []
+
+    def spawn(self) -> float:
+        """Build a fresh engine via the factory and mark HEALTHY.  Returns
+        the bring-up wall seconds (factory call: construction + compiles
+        not served by a persistent compile cache)."""
+        if self.engine is not None and not self.engine._closed:
+            raise RuntimeError(
+                f"replica {self.index} already has a live engine — close it "
+                "(router failover does) before respawning")
+        t0 = time.perf_counter()
+        self.engine = self._make_engine(self.tid)
+        self.spawn_s = time.perf_counter() - t0
+        self.spawn_history.append(self.spawn_s)
+        self.spawns += 1
+        self.state = HEALTHY
+        if self._tracer is not None:
+            self._tracer.instant("replica_spawn", cat="router", tid=self.tid,
+                                 replica=self.index, spawn=self.spawns,
+                                 spawn_s=round(self.spawn_s, 6))
+        return self.spawn_s
+
+    @property
+    def alive(self) -> bool:
+        return self.engine is not None and not self.engine._closed
+
+    def probe(self) -> bool:
+        """Liveness check the router runs each step on HEALTHY replicas.
+        The base probe is structural (an engine exists and is not closed);
+        the router's injectable ``probe=`` hook layers policy on top."""
+        return self.alive
+
+    @property
+    def load(self) -> float:
+        """Least-loaded sort key: requests ahead of a new arrival (queued +
+        parked + occupied slots) plus the live KV-pool fraction as the
+        fractional tiebreak — two replicas with equal request counts route
+        to the one with more free pages (pool-aware routing), and the
+        fraction is < 1 so it can never outvote a whole request."""
+        e = self.engine
+        if e is None:
+            return float("inf")
+        ahead = len(e.scheduler) + len(e._pending) + e.occupied
+        frac = (e._pool.allocated / e._pool.capacity
+                if e._pool is not None else e.occupied / e.slots)
+        return ahead + frac
+
+    def close(self) -> None:
+        """Close the live engine (if any) and bank its stats record for
+        the router's cluster rollup."""
+        if self.engine is not None and not self.engine._closed:
+            self.engine.close()
+            self.stats_records.append(self.engine.stats)

@@ -68,6 +68,11 @@ class Request:
     generated: list[int] = field(default_factory=list)  # engine: output
     status: str = "queued"
     error: str | None = None            # engine: why status == "failed"
+    engine_fault: bool = False          # engine: True when a terminal
+    #   failed/cancelled status is COLLATERAL of an engine-wide fault
+    #   (stall watchdog, close during an overcommit stall) rather than the
+    #   request's own poison/callback/deadline — the router's failover
+    #   re-dispatches exactly the collateral (serving/router.py)
     prefix_key: str | None = None       # blake2b content address of the
     #   (bucket, prompt) pair — the prefix-cache lookup key
     #   (serving/prefix_cache.py); filled by the scheduler at submit

@@ -242,3 +242,91 @@ class ServingStats:
 
     def emit(self, writer: MetricWriter, kind: str = "serving") -> dict:
         return writer.write(kind, **self.summary())
+
+    @classmethod
+    def merge(cls, records: list["ServingStats"]) -> dict:
+        """Cluster-level rollup over N engine records (the router's one
+        ``router`` metric record — serving/router.py).
+
+        Counters SUM; percentiles are recomputed over the MERGED request
+        samples (a percentile of percentiles is not a percentile); every
+        ratio is re-derived from merged numerator/denominator and is None
+        — never NaN — when the denominator is zero, so the record stays
+        strict-JSON.  ``kv_pages_peak`` sums per-engine peaks: an upper
+        bound on the cluster's concurrent peak (per-engine peaks need not
+        align in time).  ``per_engine`` carries each engine's own summary
+        as a sub-record, so the rollup never hides a sick replica.
+        """
+        reqs = [r for rec in records for r in rec.requests]
+        done = [r for r in reqs if r.status == "done"]
+        ttft = [r.first_token_t - r.submit_t for r in reqs
+                if r.first_token_t is not None]
+        latency = [r.finish_t - r.submit_t for r in done
+                   if r.finish_t is not None]
+        n_tokens = sum(len(r.generated) for r in reqs)
+        starts = [rec._start_t for rec in records if rec._start_t is not None]
+        ends = [rec._end_t for rec in records if rec._end_t is not None]
+        window = (max(ends) - min(starts)
+                  if starts and ends and max(ends) > min(starts) else None)
+        slots = sum(rec.slots for rec in records)
+        busy_weighted = sum(rec._busy_time * rec.slots for rec in records)
+        occ_time = sum(rec._occ_time for rec in records)
+        w_steps = sum(rec._window_steps for rec in records)
+        waste = sum(rec._waste_steps for rec in records)
+        p_hits = sum(rec._prefix_hits for rec in records)
+        p_miss = sum(rec._prefix_misses for rec in records)
+        r_hits = sum(rec._radix_hits for rec in records)
+        r_miss = sum(rec._radix_misses for rec in records)
+        compiled = [rec._compile for rec in records if rec._compile is not None]
+        out = {
+            "n_engines": len(records),
+            "slots": slots,
+            "n_requests": len(reqs),
+            "n_done": len(done),
+            "n_cancelled": sum(r.status == "cancelled" for r in reqs),
+            "n_failed": sum(r.status == "failed" for r in reqs),
+            "n_engine_fault": sum(r.engine_fault for r in reqs),
+            "tokens_generated": int(n_tokens),
+            "tokens_per_sec": (round(n_tokens / window, 3) if window else None),
+            "busy_s": round(sum(rec._busy_time for rec in records), 6),
+            "decode_steps": sum(rec._decode_steps for rec in records),
+            "slot_occupancy": (round(occ_time / busy_weighted, 4)
+                               if busy_weighted > 0 else None),
+            "n_windows": sum(rec._windows for rec in records),
+            "window_dispatch_s": round(
+                sum(rec._dispatch_time for rec in records), 6),
+            "window_readback_s": round(
+                sum(rec._readback_time for rec in records), 6),
+            "window_steps": w_steps,
+            "window_waste_steps": waste,
+            "window_waste_frac": (round(waste / w_steps, 4)
+                                  if w_steps > 0 else None),
+            "prefix_hits": p_hits,
+            "prefix_misses": p_miss,
+            "prefix_hit_rate": (round(p_hits / (p_hits + p_miss), 4)
+                                if (p_hits + p_miss) > 0 else None),
+            "prefix_oversized": sum(rec._prefix_oversized for rec in records),
+            "kv_pages_total": sum(rec._kv_pages_total for rec in records),
+            "kv_pages_live": sum(rec._kv_pages_live for rec in records),
+            "kv_pages_peak": sum(rec._kv_pages_peak for rec in records),
+            "kv_bytes_live": sum(rec._kv_pages_live * rec._kv_page_bytes
+                                 for rec in records),
+            "kv_bytes_peak": sum(rec._kv_pages_peak * rec._kv_page_bytes
+                                 for rec in records),
+            "radix_hits": r_hits,
+            "radix_misses": r_miss,
+            "radix_hit_tokens": sum(rec._radix_hit_tokens for rec in records),
+            "radix_hit_rate": (round(r_hits / (r_hits + r_miss), 4)
+                               if (r_hits + r_miss) > 0 else None),
+            "n_compiled_programs": (
+                sum(c["n_compiled_programs"] for c in compiled)
+                if compiled else None),
+            "compile_time_s": (
+                round(sum(c["compile_time_s"] for c in compiled), 6)
+                if compiled else None),
+            "per_engine": [rec.summary() for rec in records],
+        }
+        for name, xs in (("ttft_s", ttft), ("latency_s", latency)):
+            for k, v in percentiles(xs).items():
+                out[f"{name}_{k}"] = v
+        return out

@@ -42,6 +42,19 @@ Injection sites (consulted by the subsystems named in parentheses):
 ``serving-callback``      one event per user-callback delivery
                           (serving/engine.py); raises — a misbehaving
                           streaming callback.
+``router-dispatch``       one event per router→replica dispatch attempt
+                          (serving/router.py), in submission order across
+                          retries; raises — the transport fault of handing
+                          a request to a replica.  The router excludes the
+                          targeted replica for THAT request and retries the
+                          next-best survivor (at-most-once per replica).
+``weight-swap``           one event per replica weight-swap attempt
+                          (serving/router.py hot swap, after the drain and
+                          before the params replacement); raises — an
+                          interrupted swap.  The replica is re-admitted on
+                          its OLD weights (still consistent — the swap is
+                          all-or-nothing) and the watcher retries at the
+                          next poll.
 ========================  ====================================================
 
 Every hook is guarded by ``if <owner>._chaos is not None`` at the call
@@ -73,6 +86,8 @@ SITES = (
     "serving-admit",
     "serving-step",
     "serving-callback",
+    "router-dispatch",
+    "weight-swap",
 )
 
 

@@ -214,6 +214,15 @@ class CheckpointManager:
             with open(victim, "r+b") as f:
                 f.truncate(vsize // 2)
 
+    def reload(self) -> None:
+        """Re-read the step listing from disk.  Orbax caches it per
+        manager, which is correct for the writer (it performed every save)
+        and stale for an OBSERVER of someone else's directory — the
+        serving tier's WeightWatcher polls on its own manager and calls
+        this before every listing so it sees the trainer's new steps."""
+        if hasattr(self._mgr, "reload"):
+            self._mgr.reload()
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 

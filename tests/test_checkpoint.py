@@ -306,3 +306,56 @@ def test_restore_then_generate_uses_restored_weights(tmp_path):
     # (otherwise got would equal the fresh-init decode whenever they differ)
     if not np.array_equal(before, want):
         assert not np.array_equal(got, before)
+
+
+def test_intact_restore_observer_races_concurrent_writer(tmp_path):
+    """Satellite (ISSUE 8): a second CheckpointManager OBSERVING a
+    directory another manager writes — the WeightWatcher pattern.  The
+    observer's listing is refreshed by reload() (orbax caches it per
+    manager, correct for the writer, stale for a watcher); a newest step
+    whose bytes are torn restores as the PREVIOUS intact step; and the
+    intact-walk waits only on the observer's OWN in-flight saves (none),
+    so polling returns while the writer's async save is still landing —
+    it can never block the save pipeline."""
+    import os
+
+    writer = CheckpointManager(str(tmp_path / "ck"))
+    observer = CheckpointManager(str(tmp_path / "ck"))
+    _, _, good = _state(seed=1)
+    writer.save(good.replace(step=jnp.asarray(5, jnp.int32)), wait=True)
+
+    observer.reload()  # without this the cached listing still says "empty"
+    assert observer.latest_step() == 5
+    assert int(observer.restore_latest_intact(_state(seed=3)[2]).step) == 5
+
+    # the race window: the writer's NEWEST step is on disk but torn
+    # (crash mid-write / bytes landed ahead of the manifest) — the
+    # observer must skip it and land on the previous intact step
+    writer.save(good.replace(step=jnp.asarray(10, jnp.int32)), wait=True)
+    victim, vsize = None, -1
+    for dirpath, _d, files in os.walk(tmp_path / "ck" / "10"):
+        for name in files:
+            p = os.path.join(dirpath, name)
+            if os.path.getsize(p) > vsize:
+                victim, vsize = p, os.path.getsize(p)
+    with open(victim, "r+b") as f:
+        f.truncate(vsize // 2)
+    observer.reload()
+    assert observer.latest_step() == 10
+    restored = observer.restore_latest_intact(_state(seed=3)[2])
+    assert int(restored.step) == 5
+    for a, b in zip(jax.tree.leaves(good.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # an ASYNC save in flight on the writer: the observer's walk returns
+    # (torn 10 still skipped, never a hang joining the writer's save) and
+    # the writer's save completes cleanly afterwards
+    writer.save(good.replace(step=jnp.asarray(15, jnp.int32)), wait=False)
+    observer.reload()
+    got = observer.restore_latest_intact(_state(seed=3)[2])
+    assert int(got.step) in (5, 15)  # whichever side of the landing — never 10
+    writer.wait()
+    observer.reload()
+    assert int(observer.restore_latest_intact(_state(seed=3)[2]).step) == 15
+    writer.close()
+    observer.close()

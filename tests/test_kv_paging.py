@@ -28,6 +28,7 @@ import pytest
 
 from distributed_tensorflow_ibm_mnist_tpu.models import get_model
 from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
     InferenceEngine,
     KVPagePool,
     PrefixCache,
@@ -378,3 +379,33 @@ def test_bench_kv_paging_quick_smoke():
     assert rec["concurrency_ratio"] >= 2.0
     assert 0.9 <= rec["bytes_ratio"] <= 1.1  # the budget really was fixed
     assert rec["paged"]["radix_hit_tokens"] > 0
+
+
+def test_close_fails_overcommit_stalled_request_and_frees_pages():
+    """Satellite fix (ISSUE 8): close() with a request PARKED on a dry
+    page pool (overcommit stall — accepted, prefilled once, starved of
+    pages) must fail it TERMINALLY: status ``failed`` with an error
+    naming the stall, ``engine_fault`` set (the engine gave up on work it
+    had accepted — a router re-dispatches exactly these), every page
+    freed, and nothing left parked.  A queued-never-admitted request
+    still reads plain ``cancelled``."""
+    model, params = _model_and_params()
+    # 2 slots but a pool holding ONE full-length request: the second
+    # admission prefills, finds the pool dry, and parks
+    eng = InferenceEngine(model, params, slots=2, max_len=16, kv_page_size=4,
+                          kv_pages=5, radix_cache=False,
+                          scheduler=FIFOScheduler(max_len=16, buckets=(8,)))
+    r1 = eng.submit([1, 2, 3], max_new=12)
+    r2 = eng.submit([4, 5, 6], max_new=12)
+    eng.step()
+    assert r1.status == "running" and r2.status == "queued"
+    assert len(eng._pending) == 1  # r2 parked on the dry pool
+
+    eng.close()
+    assert r1.status == "cancelled" and r1.engine_fault
+    assert r2.status == "failed" and r2.engine_fault
+    assert "overcommit-stalled" in (r2.error or "")
+    assert eng._pool.allocated == 0 and not eng._pending
+    assert len(eng.scheduler) == 0
+    # both surfaced exactly once through the terminal stream
+    assert {r.id for r in eng.completed} == {r1.id, r2.id}

@@ -1,0 +1,454 @@
+"""Multi-replica serving tier (serving/router.py, serving/replica.py).
+
+The decisive properties:
+
+* PARITY — greedy decode through the router (least-loaded dispatch over N
+  replicas) is token-identical to one fault-free engine; routing is
+  invisible in the tokens.
+* FAILOVER — a replica dying mid-wave (raw decode fault, failed health
+  probe) re-dispatches exactly its ``engine_fault`` collateral to the
+  survivors: every request still retires ``done`` with identical tokens,
+  streaming callbacks deliver each token exactly once across attempts,
+  and a request's OWN failure (poison) is never retried.
+* HOT SWAP — drain → ``swap_params`` → re-admit, one replica at a time,
+  zero drops; a chaos-aborted swap re-admits on OLD weights and the next
+  ``hot_swap`` call retries exactly the straggler; a restarted replica
+  re-applies the tier's current weights.
+* ROLLUP — ``ServingStats.merge`` recomputes percentiles over merged
+  samples, sums counters, stays strict-JSON (None, never NaN), and the
+  router emits it as ONE ``router`` MetricWriter record.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
+    InferenceEngine,
+    NoHealthyReplica,
+    QueueFull,
+    Router,
+    ServingStats,
+    WeightWatcher,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+)
+
+KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6], [9, 1], [3, 3, 3, 3]]
+
+
+def _model_and_params(seed=0, **over):
+    model = get_model("causal_lm", **{**KW, **over})
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _factory(model, params, **kw):
+    def make_engine(tid):
+        return InferenceEngine(
+            model, params, slots=2, max_len=16,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=16),
+            trace_tid=tid, **kw)
+    return make_engine
+
+
+def _reference(model, params, prompts=PROMPTS, max_new=6):
+    eng = InferenceEngine(model, params, slots=2, max_len=16,
+                          scheduler=FIFOScheduler(max_len=16, buckets=(8,)))
+    reqs = [eng.submit(p, max_new=max_new) for p in prompts]
+    eng.run()
+    eng.close()
+    return [list(r.generated) for r in reqs]
+
+
+# ----------------------------------------------------------------------
+# routing
+
+
+def test_router_parity_and_least_loaded_spread():
+    """N-replica greedy output == one fault-free engine, and least-loaded
+    dispatch actually spreads the wave instead of piling on replica 0."""
+    model, params = _model_and_params()
+    want = _reference(model, params)
+    with Router(_factory(model, params), 2) as r:
+        rrs = [r.submit(p, max_new=6) for p in PROMPTS]
+        r.run_until_done()
+        assert [list(rr.generated) for rr in rrs] == want
+        assert all(rr.status == "done" for rr in rrs)
+        assert {rr.replica for rr in rrs} == {0, 1}
+        # consecutive submits against idle equal-load replicas alternate
+        assert rrs[0].replica != rrs[1].replica
+
+
+def test_router_backpressure_and_no_healthy():
+    """Every healthy queue at bound -> QueueFull (shed/retry, the single-
+    engine contract); every replica failed -> NoHealthyReplica."""
+    model, params = _model_and_params()
+
+    def tiny(tid):
+        return InferenceEngine(
+            model, params, slots=1, max_len=16,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=1),
+            trace_tid=tid)
+
+    r = Router(tiny, 2)
+    for _ in range(2):      # one queued per replica = every queue at bound
+        r.submit([1, 2], max_new=4)
+    with pytest.raises(QueueFull):
+        r.submit([1, 2], max_new=4)
+    r.run_until_done()
+    for rep in r.replicas:
+        rep.state = "failed"
+    with pytest.raises(NoHealthyReplica):
+        r.submit([1, 2], max_new=4)
+    for rep in r.replicas:  # let close() bank the stats records cleanly
+        rep.state = "healthy"
+    r.close()
+
+
+def test_dispatch_chaos_excludes_replica_and_retries_next_best():
+    """A router-dispatch chaos hit bars that replica for THAT request only
+    — the submit lands on the next-best survivor and completes."""
+    model, params = _model_and_params()
+    want = _reference(model, params, prompts=[PROMPTS[0]])
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="router-dispatch", kind="io", at=(0,)),)))
+    with Router(_factory(model, params), 2, chaos=inj) as r:
+        rr = r.submit(PROMPTS[0], max_new=6)
+        assert len(rr.excluded) == 1          # the chaos-barred replica
+        assert rr.replica not in rr.excluded  # landed elsewhere
+        r.run_until_done()
+        assert rr.status == "done" and list(rr.generated) == want[0]
+        later = r.submit(PROMPTS[1], max_new=4)   # exclusion was per-request
+        r.run_until_done()
+        assert later.status == "done" and not later.excluded
+    assert inj.summary()["by_site"] == {"router-dispatch": 1}
+
+
+# ----------------------------------------------------------------------
+# failover
+
+
+def test_failover_redispatches_collateral_token_identical_exactly_once():
+    """Chaos kills one replica's decode mid-wave (no stall watchdog: the
+    raw raise is an engine-wide fault).  The router closes it, re-dispatches
+    the engine_fault collateral, and the wave finishes token-identical with
+    exactly-once streaming delivery."""
+    model, params = _model_and_params()
+    want = _reference(model, params)
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="serving-step", kind="transient", at=(1,)),)))
+    streams: dict[int, list[int]] = {}
+    r = Router(_factory(model, params, chaos=inj, stall_timeout_s=None), 2)
+    rrs = [r.submit(p, max_new=6,
+                    callback=lambda rr, tok: streams.setdefault(
+                        rr.id, []).append(int(tok)))
+           for p in PROMPTS]
+    r.run_until_done()
+    assert [list(rr.generated) for rr in rrs] == want
+    assert all(rr.status == "done" for rr in rrs)
+    assert r.failovers == 1
+    assert sum(rr.redispatches for rr in rrs) >= 1
+    # the dead replica's casualties carry the exclusion + attempt trail
+    moved = [rr for rr in rrs if rr.redispatches]
+    assert all(len(rr.attempts) == 2 and rr.excluded for rr in moved)
+    # exactly-once: replayed prefixes suppressed, each stream == the output
+    for rr in rrs:
+        assert streams.get(rr.id, []) == list(rr.generated)
+    # the rollup separates logical requests from engine attempts
+    summ = r.summary()
+    assert summ["n_requests"] == len(PROMPTS) + len(moved)
+    assert summ["n_engine_fault"] == len(moved)
+    assert summ["replicas_failed"] == 1 and summ["failovers"] == 1
+    r.close()
+
+
+def test_failed_probe_fails_replica_and_own_faults_stay_failed():
+    """A False health-probe verdict == an engine-wide fault (failover);
+    a POISONED request's own failure is never re-dispatched."""
+    model, params = _model_and_params()
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="serving-admit", kind="poison", at=(0,)),)))
+    dead: set[int] = set()
+    r = Router(_factory(model, params, chaos=inj), 2,
+               probe=lambda rep: rep.index not in dead)
+    bad = r.submit(PROMPTS[0], max_new=4)    # admission poisons it
+    ok = r.submit(PROMPTS[1], max_new=4)
+    r.run_until_done()
+    assert bad.status == "failed" and "chaos" in (bad.error or "")
+    assert bad.redispatches == 0             # own fault, not collateral
+    assert ok.status == "done"
+    dead.add(ok.replica)                     # now flunk that replica's probe
+    r.step()
+    assert r.replicas[ok.replica].state == "failed" and r.failovers == 1
+    again = r.submit(PROMPTS[2], max_new=4)  # tier still serves on survivor
+    r.run_until_done()
+    assert again.status == "done" and again.replica != ok.replica
+    r.close()
+
+
+def test_restart_respawns_failed_replica_fresh():
+    model, params = _model_and_params()
+    r = Router(_factory(model, params), 2)
+    with pytest.raises(RuntimeError, match="not failed"):
+        r.restart(0)                          # healthy replicas don't restart
+    r.replicas[0].close()
+    r.replicas[0].state = "failed"
+    spawn_s = r.restart(0)
+    assert spawn_s > 0 and r.replicas[0].state == "healthy"
+    assert r.replicas[0].spawns == 2
+    rr = r.submit(PROMPTS[0], max_new=4)
+    r.run_until_done()
+    assert rr.status == "done"
+    r.close()
+
+
+# ----------------------------------------------------------------------
+# hot swap
+
+
+def test_hot_swap_serves_new_weights_with_traffic_in_flight():
+    """hot_swap with a request IN FLIGHT: drain never cancels (zero
+    drops), and post-swap output matches a fault-free engine on the NEW
+    params — stale prefix state cleared, no recompile needed."""
+    model, params = _model_and_params()
+    p2 = jax.tree.map(lambda x: x * 1.1, params)
+    want_new = _reference(model, p2)
+    r = Router(_factory(model, params), 2)
+    inflight = r.submit(PROMPTS[0], max_new=8)
+    assert r.hot_swap(p2, step=7) == 2
+    assert inflight.status == "done"          # drained to completion, W1
+    assert r.swapped_steps == [7]
+    assert all(rep.weight_step == 7 and rep.swaps == 1 for rep in r.replicas)
+    rrs = [r.submit(p, max_new=6) for p in PROMPTS]
+    r.run_until_done()
+    assert [list(rr.generated) for rr in rrs] == want_new
+    r.close()
+
+
+def test_swap_chaos_aborts_all_or_nothing_then_retry_covers_straggler():
+    """A weight-swap chaos hit after the drain re-admits that replica on
+    its OLD weights; re-calling hot_swap with the same step retries
+    exactly the straggler (stamped replicas are skipped)."""
+    model, params = _model_and_params()
+    p2 = jax.tree.map(lambda x: x * 1.1, params)
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="weight-swap", kind="io", at=(0,)),)))
+    r = Router(_factory(model, params), 2, chaos=inj)
+    assert r.hot_swap(p2, step=3) == 1        # first attempt chaos-aborted
+    stamped = [rep.weight_step for rep in r.replicas]
+    assert sorted(stamped, key=str) == [3, None] or stamped.count(3) == 1
+    assert r.hot_swap(p2, step=3) == 1        # exactly the straggler
+    assert all(rep.weight_step == 3 for rep in r.replicas)
+    assert [rep.swaps for rep in r.replicas] == [1, 1]  # no double drain
+    assert r.swapped_steps == [3]             # one step, recorded once
+    r.close()
+
+
+def test_restart_reapplies_current_weights():
+    """A replica restarted AFTER a hot swap must come back on the tier's
+    current weights, not the factory's stale originals."""
+    model, params = _model_and_params()
+    p2 = jax.tree.map(lambda x: x * 1.1, params)
+    want_new = _reference(model, p2, prompts=[PROMPTS[0]])
+    r = Router(_factory(model, params), 2)
+    r.hot_swap(p2, step=9)
+    r.replicas[0].close()
+    r.replicas[0].state = "failed"
+    r.restart(0)
+    assert r.replicas[0].weight_step == 9
+    # pin the restarted replica by failing the other one
+    r.replicas[1].close()
+    r.replicas[1].state = "failed"
+    rr = r.submit(PROMPTS[0], max_new=6)
+    r.run_until_done()
+    assert rr.replica == 0 and list(rr.generated) == want_new[0]
+    r.close()
+
+
+def test_swap_params_refuses_busy_engine():
+    model, params = _model_and_params()
+    eng = InferenceEngine(model, params, slots=2, max_len=16,
+                          scheduler=FIFOScheduler(max_len=16, buckets=(8,)))
+    eng.submit(PROMPTS[0], max_new=4)
+    with pytest.raises(RuntimeError, match="drain"):
+        eng.swap_params(params)
+    eng.run()
+    eng.swap_params(jax.tree.map(lambda x: x * 1.1, params))  # idle: fine
+    eng.close()
+
+
+def test_weight_watcher_polls_validates_and_rolls_out(tmp_path):
+    """WeightWatcher against a real checkpoint directory: first poll swaps
+    the intact step into every replica, an unchanged directory polls None,
+    a NEWER save rolls out with traffic in flight."""
+    import optax
+
+    from distributed_tensorflow_ibm_mnist_tpu.core import TrainState
+    from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import (
+        CheckpointManager,
+    )
+
+    model, params = _model_and_params()
+    tx = optax.adam(1e-3)
+    state = TrainState.create(model, tx, jax.random.PRNGKey(0),
+                              jnp.zeros((1, 8), jnp.int32))
+    writer = CheckpointManager(str(tmp_path / "ck"))
+    writer.save(state.replace(step=jnp.asarray(1, jnp.int32)), wait=True)
+
+    r = Router(_factory(model, state.params), 2)
+    w = WeightWatcher(str(tmp_path / "ck"), state, r)
+    assert w.poll() == 1 and w.last_step == 1
+    assert all(rep.weight_step == 1 for rep in r.replicas)
+    assert w.poll() is None                   # nothing new
+
+    state2 = state.replace(step=jnp.asarray(2, jnp.int32),
+                           params=jax.tree.map(lambda x: x * 1.1, state.params))
+    writer.save(state2, wait=True)
+    want = _reference(model, state2.params, prompts=[PROMPTS[0]], max_new=4)
+    rr = r.submit(PROMPTS[0], max_new=4)      # in flight through the swap
+    assert w.poll() == 2
+    r.run_until_done()
+    assert rr.status == "done"
+    assert r.swapped_steps == [1, 2]
+    after = r.submit(PROMPTS[0], max_new=4)
+    r.run_until_done()
+    assert list(after.generated) == want[0]
+    r.close()
+    writer.close()
+
+
+# ----------------------------------------------------------------------
+# rollup + observability
+
+
+def test_merge_sums_counters_and_recomputes_percentiles():
+    """merge() over two live engines: counters sum, percentiles come from
+    the MERGED samples (not averaged per-engine percentiles), per_engine
+    sub-records survive."""
+    model, params = _model_and_params()
+    records = []
+    total_reqs, total_tokens = 0, 0
+    for seed in (0, 1):
+        eng = InferenceEngine(model, params, slots=2, max_len=16,
+                              scheduler=FIFOScheduler(max_len=16, buckets=(8,)))
+        reqs = [eng.submit(p, max_new=4) for p in PROMPTS[: 3 + seed]]
+        eng.run()
+        eng.close()
+        total_reqs += len(reqs)
+        total_tokens += sum(len(q.generated) for q in reqs)
+        records.append(eng.stats)
+    merged = ServingStats.merge(records)
+    assert merged["n_engines"] == 2
+    assert merged["n_requests"] == total_reqs
+    assert merged["n_done"] == total_reqs
+    assert merged["tokens_generated"] == total_tokens
+    assert merged["slots"] == 4
+    assert len(merged["per_engine"]) == 2
+    all_ttft = sorted(q.first_token_t - q.submit_t
+                      for rec in records for q in rec.requests)
+    assert merged["ttft_s_p50"] == pytest.approx(
+        np.percentile(all_ttft, 50), rel=1e-6)
+
+
+def test_merge_empty_and_idle_engines_stay_strict_json():
+    """Zero-traffic merges keep every ratio None — json.dumps with
+    allow_nan=False must succeed (the strict-JSON contract)."""
+    model, params = _model_and_params()
+    eng = InferenceEngine(model, params, slots=2, max_len=16,
+                          scheduler=FIFOScheduler(max_len=16, buckets=(8,)))
+    eng.close()
+    merged = ServingStats.merge([eng.stats])
+    json.dumps(merged, allow_nan=False)       # raises on any NaN/inf
+    assert merged["tokens_per_sec"] is None
+    assert merged["slot_occupancy"] is None
+    assert merged["prefix_hit_rate"] is None
+    json.dumps(ServingStats.merge([]), allow_nan=False)
+
+
+def test_router_emits_one_merged_record(tmp_path, capsys):
+    """Router.close() with a writer emits ONE `router` record carrying
+    the cluster rollup + router counters."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+    model, params = _model_and_params()
+    path = tmp_path / "metrics.jsonl"
+    writer = MetricWriter(path=str(path), stdout=False)
+    r = Router(_factory(model, params), 2, writer=writer)
+    rrs = [r.submit(p, max_new=4) for p in PROMPTS[:3]]
+    r.run_until_done()
+    r.close()
+    writer.close()
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    routers = [rec for rec in recs if rec.get("kind") == "router"]
+    assert len(routers) == 1
+    rec = routers[0]
+    assert rec["n_replicas"] == 2 and rec["router_requests"] == len(rrs)
+    assert rec["n_requests"] == len(rrs) and rec["failovers"] == 0
+
+
+def test_router_trace_validates_with_per_replica_tracks(tmp_path):
+    """One shared tracer, one lane per replica plus the router's own:
+    failover + swap instants land on the lane they happened to and the
+    exported timeline validates clean."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        Tracer,
+        validate_trace,
+    )
+
+    model, params = _model_and_params()
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="serving-step", kind="transient", at=(1,)),)))
+    tracer = Tracer()
+    r = Router(_factory(model, params, chaos=inj, stall_timeout_s=None), 2,
+               tracer=tracer)
+    rrs = [r.submit(p, max_new=4) for p in PROMPTS]
+    r.run_until_done()
+    r.hot_swap(jax.tree.map(lambda x: x * 1.1, params), step=1)
+    r.close()
+    path = str(tmp_path / "trace.json")
+    tracer.export_trace(path)
+    assert validate_trace(path) == []
+    events = json.loads(open(path).read())["traceEvents"]
+    tracks = {e["args"]["name"] for e in events
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert {"router", "replica 0", "replica 1"} <= tracks
+    instants = {e["name"] for e in events if e.get("ph") == "i"}
+    assert {"replica_spawn", "replica_failed", "failover_redispatch",
+            "weight_swap"} <= instants
+    assert all(rr.status == "done" for rr in rrs)
+
+
+@pytest.mark.slow
+def test_router_soak_script_passes(tmp_path):
+    """The full acceptance soak (scripts/router_soak.py) in a subprocess:
+    chaos failover + aborted-then-completed hot swap + zero drops +
+    token identity + a valid trace, exit 0."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "router_soak.py")],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = [json.loads(line) for line in out.stdout.splitlines()
+           if line.startswith("{")][-1]
+    assert rec["passed"] and rec["dropped"] == 0
+    assert rec["wave1"]["identical"] and rec["wave2"]["identical"]
+    assert rec["hot_swap"]["rollout_complete"]
