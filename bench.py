@@ -27,7 +27,10 @@ request stream — scripts/bench_serving.py), and a ``chaos`` block (the
 ISSUE 3 fault-injection soak: bit-identical training recovery + isolated
 serving failures under a seeded multi-fault plan, with the zero-overhead
 and manifest-cost guards — scripts/chaos_soak.py, skip with
-DTM_BENCH_SKIP_CHAOS).
+DTM_BENCH_SKIP_CHAOS), and a ``speculative`` block (ISSUE 9: n-gram
+prompt-lookup drafting + verify-window decode vs plain decode-ahead on a
+repetitive-suffix stream, greedy parity enforced —
+scripts/bench_speculative.py, skip with DTM_BENCH_SKIP_SPEC).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -419,6 +422,47 @@ def main() -> None:
 
             print(f"bench: router phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 8 — speculative decoding (ISSUE 9): n-gram prompt-lookup
+    # drafting + one verify forward per window vs plain decode-ahead at
+    # the same window size, on a repetitive-suffix stream, plus the
+    # low-repetition control leg.  The script exits nonzero (status 4)
+    # on any greedy-parity mismatch — a speedup is only ever reported
+    # over token-identical output.  Runs scripts/bench_speculative.py in
+    # a SUBPROCESS on the CPU backend.  Skippable (DTM_BENCH_SKIP_SPEC);
+    # never sinks the headline.
+    speculative = None
+    if not os.environ.get("DTM_BENCH_SKIP_SPEC"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_speculative.py")],
+                capture_output=True, text=True, timeout=540, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "speculative":
+                    speculative = rec
+            if speculative is None or out.returncode != 0:
+                print(
+                    f"bench: speculative subprocess "
+                    f"{'produced no record' if speculative is None else 'FAILED (greedy-parity breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            print(f"bench: speculative phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -500,6 +544,10 @@ def main() -> None:
     if router is not None:
         result["router"] = {
             k: v for k, v in router.items() if k != "metric"
+        }
+    if speculative is not None:
+        result["speculative"] = {
+            k: v for k, v in speculative.items() if k != "metric"
         }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm

@@ -62,6 +62,18 @@ ISSUE 5 adds :func:`make_decode_window` on the same step core: ``window``
 fused decode+pick steps per dispatch (one ``lax.scan``), emitting a
 (B, window) token block — the decode-ahead primitive that lets the serving
 engine pay one host sync per k tokens instead of per token.
+
+ISSUE 9 adds :func:`make_verify_window`, the speculative-decoding sibling:
+instead of k sequential fused steps, ONE k-position target forward over a
+host-drafted chunk (last token + up to k−1 proposed continuations), with
+per-row acceptance computed in-program — the longest prefix of drafts the
+model's own argmax reproduces, plus its one free correction token.  The
+KV cursor is rewound to the acceptance point inside the same program;
+rejected positions hold garbage K/V that the NEXT window's k-token chunk
+overwrites before anything can attend it (decode attention writes before
+it gathers, and the causal mask never looks past a query's own position).
+Greedy-only by construction: argmax-vs-draft acceptance is exact for
+greedy decoding and would bias any sampled distribution.
 """
 
 from __future__ import annotations
@@ -301,6 +313,143 @@ def make_decode_window(model, max_len: int, window: int, ragged: bool = True,
                                    max_len, ragged, pick, pad_id)
 
     return win
+
+
+def _cache_cursor(tree):
+    """The (B,) decode cursor from a cache pytree — the first ``"index"``
+    leaf found by recursive walk.  Every block keeps its own copy but they
+    advance in lockstep, so any one of them IS the cursor; dense, int8 and
+    paged layouts all store it under this key (serving/kv_pool.py keeps the
+    paged layout's key aligned for exactly this reason)."""
+    if hasattr(tree, "items"):
+        if "index" in tree:
+            return tree["index"]
+        for sub in tree.values():
+            got = _cache_cursor(sub)
+            if got is not None:
+                return got
+    return None
+
+
+def _with_cursor(tree, index):
+    """Rebuild a cache pytree with EVERY ``"index"`` leaf replaced by
+    ``index`` — the verify window's cursor rewind.  Walks mappings only
+    (array leaves pass through untouched) and preserves the mapping type,
+    so dict and FrozenDict caches keep their pytree structure (a structure
+    change would miss the engine's jit cache and recompile)."""
+    if hasattr(tree, "items"):
+        out = {k: (index if k == "index" else _with_cursor(v, index))
+               for k, v in tree.items()}
+        return out if isinstance(tree, dict) else type(tree)(out)
+    return tree
+
+
+def _verify_window_core(model, params, cache, chunk, draft_lens, active,
+                        max_len: int, pad_id: int):
+    """ONE target forward over a (B, k) proposed chunk — the speculative
+    verify primitive (ISSUE 9), sibling of :func:`_decode_window_core`.
+
+    ``chunk[:, 0]`` is each row's last emitted token (not yet in cache —
+    the same pending-token contract the decode window uses) and
+    ``chunk[:, 1:]`` up to k−1 host-drafted continuations; ``draft_lens``
+    (B,) counts each row's real drafts (shorter rows right-pad, the mask
+    hides the padding).  The apply appends all k positions at the cursor
+    and returns per-position logits; ``preds[:, j]`` is the model's greedy
+    token AFTER consuming ``chunk[:, :j+1]``.  Draft d_j is accepted iff
+    every earlier draft matched and ``preds[:, j] == d_j`` — a cumprod of
+    the match mask — so the emitted tokens are exactly
+    ``preds[:, :acc+1]``: the accepted drafts (token-equal to the preds
+    prefix by construction) plus the model's one free correction /
+    continuation token.  This is what makes speculative greedy decoding
+    EXACT: every emitted token is the model's own argmax given the
+    verified prefix, indistinguishable from sequential decode.
+
+    The apply ran the cursor to ``idx0 + k``; it is REWOUND in-program to
+    ``idx0 + acc + 1`` (``idx0`` for inactive rows).  Positions past the
+    acceptance point hold garbage K/V — safe because the NEXT window's
+    k-token chunk starts at the rewound cursor and spans the whole garbage
+    region, and decode attention (dense and paged alike) writes its chunk
+    before it gathers, with the causal mask never admitting a position
+    past the query's own — so garbage is overwritten before anything can
+    attend it.
+    """
+    chunk = chunk.astype(jnp.int32)
+    k = chunk.shape[1]
+    active = jnp.asarray(active, bool)
+    draft_lens = jnp.asarray(draft_lens, jnp.int32)
+    pad = jnp.asarray(pad_id, jnp.int32)
+    idx0 = _cache_cursor(cache)
+    if idx0 is None:
+        raise ValueError(
+            "cache pytree has no 'index' cursor leaf — not a decode cache")
+    idx0 = jnp.asarray(idx0, jnp.int32)
+    logits, vars_ = model.apply(
+        {"params": params, "cache": cache}, chunk,
+        decode=True, max_len=max_len, ragged=True, mutable=["cache"],
+    )
+    cache = vars_["cache"]
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # (B, k)
+    lanes = jnp.arange(k - 1, dtype=jnp.int32)[None, :]          # draft lanes
+    match = (preds[:, :-1] == chunk[:, 1:]) & (lanes < draft_lens[:, None])
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    acc = jnp.where(active, acc, 0)                              # (B,)
+    n_emit = jnp.where(active, acc + 1, 0)
+    emit = active[:, None] & (
+        jnp.arange(k, dtype=jnp.int32)[None, :] < n_emit[:, None])
+    toks = jnp.where(emit, preds, pad)
+    last = jnp.take_along_axis(
+        toks, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    last = jnp.where(active, last, pad)
+    new_idx = jnp.minimum(idx0 + n_emit, max_len).astype(jnp.int32)
+    return _with_cursor(cache, new_idx), toks, acc, last
+
+
+def make_verify_window(model, max_len: int, draft_len: int,
+                       pad_id: int = 0) -> Callable:
+    """Build a jitted ``verify(params, cache, chunk, draft_lens,
+    active=None) -> (cache, tokens, accepted, last)`` — the speculative
+    verify program (ISSUE 9), the one-forward sibling of
+    :func:`make_decode_window`.
+
+    ``k = draft_len + 1`` positions per dispatch, STATIC like the decode
+    window's k: column 0 of ``chunk`` (B, k) is each row's pending last
+    token, columns 1..draft_len the host-drafted proposals (rows with
+    fewer real drafts right-pad; ``draft_lens`` (B,) masks the padding).
+    Returns the updated cache (cursor at the acceptance point), the (B, k)
+    emitted block — ``accepted[b] + 1`` real tokens per active row,
+    ``pad_id`` elsewhere — the per-row accepted-draft count, and the (B,)
+    last emitted token (the next chunk's column 0).
+
+    GREEDY ONLY: acceptance compares the model's argmax to the draft,
+    which is exact for greedy decoding and would bias any sampled
+    distribution — the serving engine refuses ``speculative=`` with
+    ``temperature > 0`` at construction.  Economics: one k-position
+    forward replaces up to k sequential decode steps when drafts hit; a
+    total miss still emits 1 token (a plain decode step with k−1 wasted
+    lanes), so the parity gate — output token-identical to non-speculative
+    greedy — holds at ANY accept rate (pinned in
+    tests/test_speculative.py).  Cache-layout agnostic exactly like the
+    decode window: the cursor rewind rewrites every block's ``"index"``
+    leaf, present in dense, int8 and paged pytrees alike.
+    """
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    k = draft_len + 1
+
+    @jax.jit
+    def verify(params, cache, chunk, draft_lens, active=None):
+        b, kk = chunk.shape
+        if kk != k:
+            raise ValueError(
+                f"chunk must be (B, draft_len+1={k}), got (B, {kk})")
+        if active is None:
+            active = jnp.ones((b,), bool)
+        return _verify_window_core(model, params, cache, chunk, draft_lens,
+                                   active, max_len, pad_id)
+
+    return verify
 
 
 def init_cache(model, params, batch: int, max_len: int):

@@ -360,9 +360,30 @@ class TransformerBlock(nn.Module):
             if self.rope:
                 q = apply_rope(q, offset=idx)
                 k = apply_rope(k, offset=idx)
-            row_update = jax.vmap(
-                lambda c, u, i: jax.lax.dynamic_update_slice(
-                    c, u, (i,) + (0,) * (c.ndim - 1)))
+            if s == 1:
+                row_update = jax.vmap(
+                    lambda c, u, i: jax.lax.dynamic_update_slice(
+                        c, u, (i,) + (0,) * (c.ndim - 1)))
+            else:
+                # multi-token ragged chunks (speculative verify windows,
+                # core/generate.py make_verify_window): per-POSITION
+                # clamped scatter, NOT a dynamic_update_slice — DUS clamps
+                # the chunk's START, so a row overrunning max_len (a
+                # retiring row within k-1 of its budget in a tight cache)
+                # would have its whole chunk SHIFTED back over real
+                # history.  Clamping each position piles the overflow onto
+                # max_len-1 instead, which never holds live data (the
+                # admission contract prompt+max_new <= max_len puts the
+                # last real position at max_len-2), so within-budget
+                # positions stay exact — the same overrun contract the
+                # paged write path already has.
+                rows_ = jnp.arange(b)[:, None]
+                pos_ = jnp.minimum(
+                    idx[:, None] + jnp.arange(s), max_len - 1)
+
+                def row_update(c, u, i):
+                    del i  # positions are precomputed (and clamped) above
+                    return c.at[rows_, pos_].set(u.astype(c.dtype))
             if quant:
                 k_st, k_sc = quantize_kv_int8(k)
                 v_st, v_sc = quantize_kv_int8(v)

@@ -18,6 +18,11 @@ Mesh-TensorFlow separation of device program from execution driver
 * :class:`~.radix_cache.RadixCache` — radix trie over token blocks:
   refcounted prompt-prefix pages shared between requests (the exact-match
   prefix cache's generalization; partial hits skip prefill compute)
+* :class:`~.drafter.NgramDrafter` — model-free prompt-lookup drafting for
+  speculative decoding (ISSUE 9, ``speculative="ngram"``): one verify
+  forward accepts multiple host-drafted tokens per window with EXACT
+  greedy parity; ``InferenceEngine.prewarm()`` / ``Router.prewarm()``
+  compile the full program family in the launch path (ROADMAP 5a)
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
   slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
   compile accounting (``n_compiled_programs`` — ISSUE 6), emitted through
@@ -41,6 +46,7 @@ it as a per-phase latency table.  See docs/OBSERVABILITY.md.
 See docs/SERVING.md for the architecture and knobs.
 """
 
+from distributed_tensorflow_ibm_mnist_tpu.serving.drafter import NgramDrafter
 from distributed_tensorflow_ibm_mnist_tpu.serving.engine import (
     EngineStalled,
     InferenceEngine,
@@ -71,6 +77,7 @@ __all__ = [
     "InferenceEngine",
     "FIFOScheduler",
     "KVPagePool",
+    "NgramDrafter",
     "NoHealthyReplica",
     "PrefixCache",
     "QueueFull",

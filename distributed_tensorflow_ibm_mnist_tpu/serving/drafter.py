@@ -1,0 +1,94 @@
+"""Model-free n-gram / prompt-lookup drafting for speculative decoding.
+
+The draft model problem, deleted: instead of a second (small) LM proposing
+continuations — extra HBM, a second program family, a distillation
+pipeline — the drafter exploits the observation that served text is full
+of REPETITION (retrieved context quoted back, code identifiers, boilerplate,
+chat templates): the most recent prior occurrence of the current suffix
+n-gram is a strong predictor of what comes next.  Drafting is a pure
+host-side numpy suffix match over the request's own prompt + generated
+tokens, so it costs microseconds, needs no weights, and can never be
+stale — the context IS the request.
+
+A draft is only ever a PROPOSAL: the verify window
+(core/generate.py ``make_verify_window``) runs the target model over the
+drafted block and accepts exactly the prefix the model's own greedy argmax
+reproduces, so a bad draft costs wasted verify lanes, never a wrong token.
+On low-repetition (adversarial) text the match rate drops toward zero and
+speculative decoding degrades to plain decode — one emitted token per
+window — which is the honest floor documented in docs/PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: propose the continuation of the most recent
+    prior occurrence of the context's suffix n-gram.
+
+    For ``n`` from ``max_ngram`` down to ``min_ngram``, take the last n
+    tokens of the context as the pattern, find its most recent EARLIER
+    occurrence, and propose the ``draft_len`` tokens that follow it.
+    Longer patterns are tried first (a longer match is more predictive);
+    the first hit wins.  A match ``p`` tokens before the suffix means the
+    stream is locally ``p``-periodic, so the proposal extends PERIODICALLY
+    past the end of the context (token ``j`` is predicted as token
+    ``j - p``, self-referencing into the draft once ``j`` passes the
+    context) — without this, a short-period stream could never fill a
+    draft longer than its period, exactly the high-acceptance case
+    drafting exists for.  No match at any n returns an empty draft — the
+    verify window still emits its one guaranteed token, so an empty draft
+    is a plain decode step, not a stall.
+
+    ``max_context`` bounds the searched suffix (the match scan is O(context)
+    per window on the host); 0 = unbounded.
+    """
+
+    def __init__(self, draft_len: int, max_ngram: int = 3,
+                 min_ngram: int = 1, max_context: int = 4096):
+        if draft_len < 1:
+            raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}/{max_ngram}")
+        if max_context < 0:
+            raise ValueError(f"max_context must be >= 0, got {max_context}")
+        self.draft_len = int(draft_len)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_context = int(max_context)
+
+    def draft(self, context: np.ndarray) -> np.ndarray:
+        """Up to ``draft_len`` proposed continuations of ``context`` (1-D
+        int array: the request's prompt + every generated token, the last
+        of which is the pending token the verify chunk leads with).
+        Returns a possibly-empty int32 array, never longer than
+        ``draft_len``."""
+        ctx = np.asarray(context, np.int32).ravel()
+        if self.max_context and ctx.size > self.max_context:
+            ctx = ctx[-self.max_context:]
+        n_ctx = ctx.size
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if n_ctx <= n:  # pattern must have at least one earlier slot
+                continue
+            pat = ctx[-n:]
+            # candidate starts: every position whose n-gram equals the
+            # suffix, EXCLUDING the suffix occurrence itself
+            wins = np.lib.stride_tricks.sliding_window_view(
+                ctx[:-1], n) if n_ctx - 1 >= n else None
+            if wins is None:
+                continue
+            hits = np.nonzero((wins == pat[None, :]).all(axis=1))[0]
+            if hits.size == 0:
+                continue
+            s = int(hits[-1])  # most recent prior occurrence
+            period = (n_ctx - n) - s  # suffix start minus match start
+            out = np.empty((self.draft_len,), np.int32)
+            for i in range(self.draft_len):
+                j = n_ctx - period + i
+                out[i] = ctx[j] if j < n_ctx else out[i - period]
+            return out
+        return np.zeros((0,), np.int32)

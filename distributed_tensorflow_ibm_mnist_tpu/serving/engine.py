@@ -58,6 +58,38 @@ Two more host-loop latencies hide behind the window (ISSUE 5):
   a pending request whose deadline lapses before landing is cancelled at
   landing time (the prefill was the overlap gamble's stake).
 
+Speculative decoding (ISSUE 9, ``speculative="ngram"``): the decode-ahead
+window still emits ONE token per model step — k tokens cost k sequential
+forwards.  Speculative mode replaces the window with its verify sibling
+(core/generate.py ``make_verify_window``): between dispatches the host
+drafts up to ``draft_len`` continuation tokens per slot with a model-free
+prompt-lookup drafter (serving/drafter.py — suffix n-gram match over the
+request's own prompt + generated stream), and ONE (slots, draft_len+1)-
+position target forward verifies the whole chunk, accepting per slot the
+longest drafted prefix the model's own greedy argmax reproduces plus one
+free correction token.  Every accepted lane is a sequential forward the
+engine didn't run; a rejected lane costs a wasted verify position, never
+a wrong token — output is token-identical to plain greedy decode by
+construction (the emitted tokens ARE the argmax chain), pinned across
+dense/paged/int8 layouts in tests/test_speculative.py.  The KV cursor is
+rewound in-graph to the acceptance point, so rejected positions are
+garbage the next window overwrites — the same
+wasted-FLOPs-never-corruption contract as decode-ahead overrun, on both
+layouts (paged allocation already budgets len+max_new; ISSUE 7).  Greedy
+only (``temperature=0``) and incompatible with sliding-window attention
+(both rejected at construction).  The chaos contract is unchanged: one
+``serving-step`` event per window dispatch, whether that window decodes
+or verifies.  ``ServingStats`` gains drafted/accepted/corrected counters,
+``accept_rate``, and ``useful_tokens_per_window``; each request's trace
+track gains per-window draft/verify/accept spans.
+
+Launch-path prewarm (ROADMAP item 5a, :meth:`InferenceEngine.prewarm`):
+every program above compiles lazily at first use, so the first requests
+eat the whole compile bill as TTFT.  ``prewarm()`` runs the engine's full
+program family once with dummy inputs before traffic — paired with
+``compile_cache_dir=`` the compiles also persist across processes, and
+``Router.prewarm()`` fans the warmup across replicas.
+
 Greedy decode through this loop is token-for-token identical to
 ``make_generator`` for every ``decode_ahead`` (both run the same
 ``_prefill_core``/``_decode_step_core`` math; pinned in
@@ -97,10 +129,12 @@ import numpy as np
 from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
     _decode_window_core,
     _filter_logits,
+    _verify_window_core,
     init_cache,
     make_prefill,
 )
 from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_slots
+from distributed_tensorflow_ibm_mnist_tpu.serving.drafter import NgramDrafter
 from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
     KVPagePool,
     init_paged_cache,
@@ -140,6 +174,12 @@ class InferenceEngine:
     buckets are the compiled prefill shapes.  ``decode_ahead=k`` runs k
     fused decode steps per dispatch/readback (greedy output is
     k-invariant; see the module docs for the waste trade).
+    ``speculative="ngram"`` swaps the decode window for the speculative
+    verify window: a host-side prompt-lookup drafter proposes up to
+    ``draft_len`` tokens per slot per window and one target forward
+    accepts the longest greedy-matching prefix + one correction token —
+    output stays token-identical to plain greedy decode; greedy-only,
+    and exclusive with sliding-window attention (see module docs).
     ``prefix_cache_bytes`` arms the prompt prefix cache (greedy only).
 
     ``kv_page_size=ps`` switches the decode cache to the PAGED layout
@@ -180,6 +220,7 @@ class InferenceEngine:
                  scheduler: FIFOScheduler | None = None,
                  buckets: tuple[int, ...] | None = None,
                  decode_ahead: int = 1,
+                 speculative: str | None = None, draft_len: int = 3,
                  prefix_cache_bytes: int = 0,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  radix_cache: bool | None = None,
@@ -204,6 +245,25 @@ class InferenceEngine:
             raise ValueError(
                 f"decode_ahead must be >= 1 (1 = one decode step per host "
                 f"sync, the classic loop), got {decode_ahead}")
+        if speculative not in (None, "ngram"):
+            raise ValueError(
+                f"speculative must be None or 'ngram' (model-free prompt-"
+                f"lookup drafting), got {speculative!r}")
+        if speculative is not None:
+            if draft_len < 1:
+                raise ValueError(
+                    f"draft_len must be >= 1 (tokens drafted per verify "
+                    f"window), got {draft_len}")
+            if temperature != 0.0:
+                raise ValueError(
+                    "speculative decoding verifies drafts against the "
+                    "model's GREEDY argmax — exact for temperature == 0, "
+                    "biased for sampling; disable one")
+            if getattr(model, "window", 0):
+                raise ValueError(
+                    "speculative decoding does not compose with sliding-"
+                    "window attention (model.window > 0): an overrunning "
+                    "verify chunk would mislabel the windowed span gather")
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
                 f"eos_id and pad_id must differ (both {eos_id}): idle slots "
@@ -261,6 +321,12 @@ class InferenceEngine:
         self.slots = slots
         self.max_len = max_len
         self.decode_ahead = int(decode_ahead)
+        self.speculative = speculative
+        self.draft_len = int(draft_len) if speculative is not None else 0
+        # host-side prompt-lookup drafter (serving/drafter.py): pure numpy
+        # suffix match over each request's prompt + generated tokens
+        self._drafter = (
+            NgramDrafter(self.draft_len) if speculative == "ngram" else None)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
         self.clock = clock
@@ -382,6 +448,23 @@ class InferenceEngine:
                 True, _pick, pad_id_)
 
         self._window = jax.jit(_window_impl, donate_argnums=(1,))
+
+        if speculative is not None:
+            # the speculative sibling: ONE (slots, draft_len+1)-position
+            # target forward that verifies a host-drafted chunk, computes
+            # per-slot acceptance in-graph, and rewinds the KV cursor to
+            # the acceptance point (core/generate.py _verify_window_core).
+            # In spec mode this REPLACES the decode-ahead scan as the
+            # per-window dispatch: drafting happens on the host between
+            # windows, which a fused k-step scan could never pause for.
+            def _verify_impl(params, cache, chunk, draft_lens, active):
+                return _verify_window_core(
+                    decode_model, params, cache, chunk, draft_lens, active,
+                    max_len, pad_id_)
+
+            self._verify = jax.jit(_verify_impl, donate_argnums=(1,))
+        else:
+            self._verify = None
 
         if kv_page_size:
             # partial-prefix prefill: compute only the unshared suffix of a
@@ -970,7 +1053,13 @@ class InferenceEngine:
         decoded = False
         occupied_at_dispatch = self.occupied
         if occupied_at_dispatch > 0:
-            k = self.decode_ahead
+            spec = self._verify is not None
+            # speculative mode replaces the decode-ahead scan with ONE
+            # (slots, draft_len+1)-position verify forward per window —
+            # host drafting must run between windows, which a fused k-step
+            # scan could never pause for — so the window length k is the
+            # verify chunk size, not decode_ahead
+            k = self.draft_len + 1 if spec else self.decode_ahead
             # the engine-track (tid 0) view of this window; request-track
             # spans tell each request's story, this tells the loop's.
             # Emitted as already-closed `complete` spans from the stats
@@ -989,16 +1078,44 @@ class InferenceEngine:
                     # step): the event index is the dispatch count, which
                     # keeps seeded plans stable across decode_ahead
                     self._chaos.raise_if_fired("serving-step", ChaosFault)
-                if self._tok_dev is None:
+                if spec:
+                    # ---- host drafting: build the (slots, k) chunk ----
+                    # column 0 = each slot's pending last token (the same
+                    # contract the decode window's tok carry uses), then
+                    # up to draft_len prompt-lookup proposals per slot
+                    t_d0 = self.clock()
+                    chunk = np.full((self.slots, k), self.pad_id, np.int32)
+                    chunk[:, 0] = self._slot_tok
+                    dls = np.zeros((self.slots,), np.int32)
+                    for slot, req in enumerate(self._slot_req):
+                        if req is None:
+                            continue
+                        d = self._drafter.draft(np.concatenate(
+                            [req.tokens,
+                             np.asarray(req.generated, np.int32)]))
+                        if d.size:
+                            chunk[slot, 1:1 + d.size] = d
+                            dls[slot] = d.size
+                    with self._compile.site("slot_draft"):
+                        chunk_dev = jnp.asarray(chunk)
+                        dls_dev = jnp.asarray(dls)
+                    t_d1 = self.clock()
+                elif self._tok_dev is None:
                     self._tok_dev = jnp.asarray(self._slot_tok)
                 if self._active_dev is None:
                     self._active_dev = jnp.asarray(
                         np.array([r is not None for r in self._slot_req]))
                 t_disp = self.clock()
-                with self._compile.site(f"decode_window[k{k}]"):
-                    self.cache, blk_dev, last_dev = self._window(
-                        self.params, self.cache, self._tok_dev,
-                        self._active_dev, self._window_rngs())
+                if spec:
+                    with self._compile.site(f"verify_window[k{k}]"):
+                        self.cache, blk_dev, acc_dev, _ = self._verify(
+                            self.params, self.cache, chunk_dev, dls_dev,
+                            self._active_dev)
+                else:
+                    with self._compile.site(f"decode_window[k{k}]"):
+                        self.cache, blk_dev, last_dev = self._window(
+                            self.params, self.cache, self._tok_dev,
+                            self._active_dev, self._window_rngs())
                 dispatch_s = self.clock() - t_disp
             except Exception as e:
                 now = self.clock()
@@ -1041,19 +1158,53 @@ class InferenceEngine:
                 # carry token) feeds the next window without a host slice
                 t_rb = self.clock()
                 blk = np.asarray(blk_dev)
+                acc = np.asarray(acc_dev) if spec else None
                 readback_s = self.clock() - t_rb
-                self._tok_dev = last_dev
-                self._slot_tok = blk[:, -1].copy()
+                if spec:
+                    # each slot's pending token is acceptance-dependent —
+                    # set per slot below; the device token mirror is never
+                    # read in spec mode (the chunk re-uploads fresh)
+                    self._tok_dev = None
+                else:
+                    self._tok_dev = last_dev
+                    self._slot_tok = blk[:, -1].copy()
                 now = self.clock()
+                t_acc0 = t_rb + readback_s
                 waste = 0
                 for slot, req in enumerate(self._slot_req):
                     if req is None:
                         continue
-                    stopped_at = None
-                    for j in range(k):
+                    n_emit = k
+                    if spec:
+                        # accepted drafts + the model's one free correction
+                        # token: emitted tokens are exactly blk[:, :acc+1]
+                        n_emit = int(acc[slot]) + 1
+                        self._slot_tok[slot] = blk[slot, n_emit - 1]
+                        self.stats.spec(int(dls[slot]), int(acc[slot]))
+                        if self._tracer is not None and req.trace is not None:
+                            # draft/verify/accept land on the REQUEST's
+                            # track BEFORE the token loop, so a mid-
+                            # acceptance retirement (which closes the
+                            # request's trace tree) cannot lose them
+                            par = req.trace.get("phase") or req.trace["id"]
+                            rtid = req.trace["tid"]
+                            self._tracer.complete(
+                                "draft", t_d0, t_d1, cat="speculative",
+                                parent=par, tid=rtid, drafted=int(dls[slot]))
+                            self._tracer.complete(
+                                "verify", t_disp, t_acc0, cat="speculative",
+                                parent=par, tid=rtid)
+                            self._tracer.complete(
+                                "accept", t_acc0, now, cat="speculative",
+                                parent=par, tid=rtid,
+                                accepted=int(acc[slot]),
+                                drafted=int(dls[slot]))
+                    appended = 0
+                    for j in range(n_emit):
                         tok = int(blk[slot, j])
                         req.generated.append(tok)
                         produced += 1
+                        appended += 1
                         try:
                             self._notify(req, tok)
                         except Exception as e:
@@ -1064,18 +1215,20 @@ class InferenceEngine:
                             self._active_dev = None
                             self._fail(req, e, now)
                             reset_mask[slot] = True
-                            stopped_at = j
                             break
                         reason = self._done_reason(req)
                         if reason is not None:
                             # EOS/budget mid-window: keep tokens up to and
                             # including the stop, discard the ≤k-1 overrun
-                            self._retire(slot, reason, now, waste=k - 1 - j)
+                            self._retire(slot, reason, now,
+                                         waste=k - appended)
                             reset_mask[slot] = True
-                            stopped_at = j
                             break
-                    if stopped_at is not None:
-                        waste += k - 1 - stopped_at
+                    # this slot dispatched k device steps (scan steps in
+                    # plain mode, verify lanes in spec mode) and delivered
+                    # `appended` tokens — the remainder (post-stop overrun
+                    # / rejected lanes) is the window's waste
+                    waste += k - appended
                 self.stats.window(dispatch_s, readback_s,
                                   steps=occupied_at_dispatch * k, waste=waste)
                 if self._tracer is not None:
@@ -1275,3 +1428,99 @@ class InferenceEngine:
             # every node is unreferenced on an idle engine; evict the lot
             self._radix.evict(self._radix.n_blocks,
                               lambda p: self._pool.free([p]))
+
+    # ------------------------------------------------------------------
+    # launch-path compile prewarm (ROADMAP item 5a)
+
+    def prewarm(self) -> dict:
+        """Compile the engine's ENTIRE program family before the first
+        request — the launch-path half of the cold-start fix (ROADMAP item
+        5a; the persistent compile cache from ISSUE 7 is the cross-process
+        half, and ``compile_cache_dir=`` makes these compiles land there).
+
+        Runs each resident program once with zero/dummy inputs on the IDLE
+        engine: every bucket's prefill(+pick), the window program this
+        mode actually dispatches (decode window, or the verify window in
+        speculative mode), the slot insert/reset, and — paged — every
+        bucket's suffix-extend.  Execution (not ``lower().compile()``)
+        is deliberate: it populates the real jit call caches, so the first
+        request pays ZERO compile anywhere, and the compile events fire
+        under the same ``CompileTracker`` site labels they would at first
+        use — the census budget sees the identical program family, just
+        earlier.  Dummy work is confined to idle-slot garbage the engine's
+        contract already tolerates (all-inactive masks, the trash page,
+        rows an insert overwrites at admission), and the engine's rng
+        stream is never consumed, so prewarmed output is token-identical
+        to cold output.
+
+        Returns ``{"programs", "compile_s", "wall_s", "by_site"}`` — the
+        compile delta this call caused (0 programs on a warm persistent
+        cache is the success case the bench ``compile_cache`` block
+        measures as cold-vs-prewarmed TTFT).
+        """
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self.has_work:
+            raise RuntimeError(
+                f"prewarm on a busy engine (occupied={self.occupied}, "
+                f"pending={len(self._pending)}, "
+                f"queued={len(self.scheduler)}) — prewarm belongs in the "
+                "launch path, before the first submit")
+        t0 = self.clock()
+        before = self._compile.snapshot()
+        rng = jax.random.PRNGKey(0)  # never self._rng: the stream must
+        # be untouched so prewarmed sampling output == cold output
+        for b in self.buckets:
+            with self._compile.site(f"prefill[b{b}]"):
+                self._prefill_and_pick(
+                    self.params, jnp.zeros((1, b), jnp.int32),
+                    jnp.ones((1,), jnp.int32), rng)
+        # a zeroed B=1 prefill row in the dense decode layout — the same
+        # eval_shape probe init_cache uses, so dtypes (incl. int8+scales)
+        # match what a real prefill hands to insert
+        row_shapes = jax.eval_shape(
+            lambda p: self.model.apply(
+                {"params": p}, jnp.zeros((1, 1), jnp.int32),
+                decode=True, max_len=self.max_len, ragged=True,
+                mutable=["cache"])[1]["cache"],
+            self.params)
+        row_cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), row_shapes)
+        slot0 = jnp.asarray(0, jnp.int32)
+        if self._pool is not None:
+            bt_row = jnp.zeros((self.max_len // self._page_size,), jnp.int32)
+            with self._compile.site("slot_insert"):
+                self.cache = self._insert(self.cache, row_cache, bt_row,
+                                          slot0)
+            for b in self.buckets:
+                with self._compile.site(f"extend[b{b}]"):
+                    self.cache, _ = self._extend(
+                        self.params, self.cache, slot0, bt_row,
+                        jnp.zeros((1, b), jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(1, jnp.int32), rng)
+        else:
+            with self._compile.site("slot_insert"):
+                self.cache = self._insert(self.cache, row_cache, slot0)
+        inactive = jnp.zeros((self.slots,), bool)
+        if self._verify is not None:
+            k = self.draft_len + 1
+            with self._compile.site(f"verify_window[k{k}]"):
+                self.cache, _, _, _ = self._verify(
+                    self.params, self.cache,
+                    jnp.full((self.slots, k), self.pad_id, jnp.int32),
+                    jnp.zeros((self.slots,), jnp.int32), inactive)
+        else:
+            k = self.decode_ahead
+            with self._compile.site(f"decode_window[k{k}]"):
+                self.cache, _, _ = self._window(
+                    self.params, self.cache,
+                    jnp.zeros((self.slots,), jnp.int32), inactive,
+                    jnp.broadcast_to(rng, (k,) + rng.shape))
+        with self._compile.site("slot_reset"):
+            self.cache = self._reset(self.cache, inactive)
+        delta = CompileTracker.delta(self._compile.snapshot(), before)
+        return {"programs": delta["n_compiled_programs"],
+                "compile_s": delta["compile_time_s"],
+                "wall_s": round(self.clock() - t0, 6),
+                "by_site": delta["by_site"]}

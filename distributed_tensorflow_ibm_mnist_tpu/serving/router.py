@@ -394,6 +394,32 @@ class Router:
     # ------------------------------------------------------------------
     # replica lifecycle
 
+    def prewarm(self) -> dict:
+        """Fan :meth:`InferenceEngine.prewarm` across every healthy
+        replica — the launch-path half of ROADMAP item 5a: compile each
+        replica's full program family BEFORE the first request, so no
+        request anywhere in the tier pays first-use compile as TTFT.
+        When the factory wires ``compile_cache_dir=``, the first replica
+        compiles and the rest (and every later respawn) hit the
+        persistent cache.  Call after construction, before traffic.
+
+        Returns per-replica prewarm reports keyed by replica index
+        (see :meth:`InferenceEngine.prewarm`), plus ``"total_s"``.
+        """
+        if self._closed:
+            raise RuntimeError("router is closed")
+        t0 = self.clock()
+        by_replica = {}
+        for rep in self.healthy():
+            by_replica[rep.index] = rep.engine.prewarm()
+        out = {"replicas": by_replica,
+               "total_s": round(self.clock() - t0, 6)}
+        if self._tracer is not None:
+            self._tracer.instant(
+                "prewarm", cat="router", tid=self.tid,
+                replicas=len(by_replica), total_s=out["total_s"])
+        return out
+
     def restart(self, index: int) -> float:
         """Respawn a FAILED replica in place (fresh engine via the factory
         — warm when the factory wires a persistent compile cache).  When

@@ -26,6 +26,16 @@ The serving analog of the trainer's metrics-of-record discipline
   against the sync savings.
 * **prefix cache** — hits/misses of the prompt prefix cache
   (serving/prefix_cache.py); a hit skips one whole prefill dispatch.
+* **speculative acceptance** (ISSUE 9) — per verify window and slot:
+  ``drafted`` tokens proposed by the n-gram drafter, ``accepted`` drafts
+  the target model's argmax reproduced, ``corrected`` free
+  correction/continuation tokens (one per verified slot).
+  ``accept_rate = accepted / drafted`` is the drafter's quality;
+  ``useful_tokens_per_window = (window_steps − waste) / n_windows`` is the
+  figure speculation actually improves (plain decode-ahead pins it at ≤ k
+  sequential steps per dispatch; speculation emits ``accepted + 1`` tokens
+  for ONE k-position forward).  Both are None — never NaN — when their
+  denominators are zero, so dense/plain records keep a stable schema.
 
 Percentiles are p50/p95/p99 over completed requests (cancelled requests
 count in TTFT if they got a first token, and in the cancel counter, not in
@@ -76,6 +86,11 @@ class ServingStats:
         self._prefix_hits = 0
         self._prefix_misses = 0
         self._prefix_oversized = 0
+        # --- speculative acceptance accounting (ISSUE 9) --- all zero on
+        # non-speculative engines, so the schema stays stable across modes
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_corrected = 0
         # --- paged KV pool + radix prefix accounting (ISSUE 7) --- the
         # engine samples pool occupancy each step (pool_sample) and records
         # each admission's radix-match outcome (radix); all zero/None for
@@ -116,6 +131,16 @@ class ServingStats:
             self._prefix_hits += 1
         else:
             self._prefix_misses += 1
+
+    def spec(self, drafted: int, accepted: int, corrected: int = 1) -> None:
+        """One slot's outcome in one speculative verify window: ``drafted``
+        tokens proposed, ``accepted`` of them reproduced by the target
+        model's argmax, plus ``corrected`` free correction/continuation
+        tokens (1 per verified slot — the model's own next token after the
+        accepted prefix, emitted whether or not anything was accepted)."""
+        self._spec_drafted += int(drafted)
+        self._spec_accepted += int(accepted)
+        self._spec_corrected += int(corrected)
 
     def prefix_oversized(self, count: int) -> None:
         """Absolute count of PrefixCache.put refusals (entry > max_bytes);
@@ -207,6 +232,19 @@ class ServingStats:
                 if (self._prefix_hits + self._prefix_misses) > 0 else None
             ),
             "prefix_oversized": self._prefix_oversized,
+            # speculative acceptance (all-zero/None on non-spec engines)
+            "drafted_tokens": self._spec_drafted,
+            "accepted_tokens": self._spec_accepted,
+            "corrected_tokens": self._spec_corrected,
+            "accept_rate": (
+                round(self._spec_accepted / self._spec_drafted, 4)
+                if self._spec_drafted > 0 else None
+            ),
+            "useful_tokens_per_window": (
+                round((self._window_steps - self._waste_steps)
+                      / self._windows, 4)
+                if self._windows > 0 else None
+            ),
             # paged KV pool (all-zero/None on dense engines)
             "kv_page_size": self._kv_page_size or None,
             "kv_pages_total": self._kv_pages_total,
@@ -275,6 +313,9 @@ class ServingStats:
         waste = sum(rec._waste_steps for rec in records)
         p_hits = sum(rec._prefix_hits for rec in records)
         p_miss = sum(rec._prefix_misses for rec in records)
+        drafted = sum(rec._spec_drafted for rec in records)
+        accepted = sum(rec._spec_accepted for rec in records)
+        n_windows = sum(rec._windows for rec in records)
         r_hits = sum(rec._radix_hits for rec in records)
         r_miss = sum(rec._radix_misses for rec in records)
         compiled = [rec._compile for rec in records if rec._compile is not None]
@@ -292,7 +333,7 @@ class ServingStats:
             "decode_steps": sum(rec._decode_steps for rec in records),
             "slot_occupancy": (round(occ_time / busy_weighted, 4)
                                if busy_weighted > 0 else None),
-            "n_windows": sum(rec._windows for rec in records),
+            "n_windows": n_windows,
             "window_dispatch_s": round(
                 sum(rec._dispatch_time for rec in records), 6),
             "window_readback_s": round(
@@ -306,6 +347,17 @@ class ServingStats:
             "prefix_hit_rate": (round(p_hits / (p_hits + p_miss), 4)
                                 if (p_hits + p_miss) > 0 else None),
             "prefix_oversized": sum(rec._prefix_oversized for rec in records),
+            # acceptance counters SUM; accept_rate re-derives over the
+            # merged totals (a rate of rates overweights idle engines) and
+            # stays None when nothing was drafted cluster-wide
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            "corrected_tokens": sum(rec._spec_corrected for rec in records),
+            "accept_rate": (round(accepted / drafted, 4)
+                            if drafted > 0 else None),
+            "useful_tokens_per_window": (
+                round((w_steps - waste) / n_windows, 4)
+                if n_windows > 0 else None),
             "kv_pages_total": sum(rec._kv_pages_total for rec in records),
             "kv_pages_live": sum(rec._kv_pages_live for rec in records),
             "kv_pages_peak": sum(rec._kv_pages_peak for rec in records),

@@ -68,10 +68,14 @@ Two more legs (ISSUE 7, paged KV):
   CI instead of silently inflating compile time.
 * **compile_cache** — the opt-in persistent compilation cache
   (``compile_cache_dir=`` / ``train.py --compile-cache-dir``) measured
-  honestly: two SUBPROCESSES share a temp cache dir (an in-process rerun
+  honestly: SUBPROCESSES share a temp cache dir (an in-process rerun
   would hit jax's in-memory jit cache and prove nothing); the cold run
   populates the dir, the warm run must add no files, and cold-vs-warm
-  compile seconds come from each process's own CompileTracker.
+  compile seconds come from each process's own CompileTracker.  A third
+  probe (ISSUE 9 satellite, ROADMAP 5a) calls ``engine.prewarm()``
+  before its first submit and reports cold-vs-prewarmed first-request
+  TTFT — the launch path absorbing the compile bill instead of the
+  first request.
 
 ``DTM_BENCH_QUICK=1`` shrinks models/streams to a CI smoke of the same
 code paths (exercised by a ``slow``-marked test so harness rot is caught
@@ -334,7 +338,17 @@ CENSUS_BUDGET = {
     "bucket32_repeat": 0,
     "paged_cold": 5,        # paged prefill/insert/window/reset + extend
     "paged_repeat": 0,      # paging adds programs once, not per request
+    "spec_cold": 7,         # prefill[b16](+pick) + verify_window[k4] +
+    #                         insert + reset + 2 unattributed helper jits
+    "spec_repeat": 0,       # speculation adds its programs once too
 }
+
+# Per-site pins for the speculative leg (ISSUE 9): the verify window is
+# ONE program for its k, and the host-side draft upload (`slot_draft`)
+# compiles NOTHING — drafting is numpy + a device transfer; a program
+# appearing under slot_draft means drafting grew a jit, which is the
+# regression this pin catches.
+SPEC_SITE_BUDGET = {"verify_window[k4]": 1, "slot_draft": 0}
 
 
 def run_compile_census(slots: int) -> dict:
@@ -351,7 +365,11 @@ def run_compile_census(slots: int) -> dict:
     3. first bucket-32 request: EXACTLY the new bucket's prefill program;
     4. second bucket-32 request: zero again;
     5. paged_cold: the paged family (+ the radix suffix-extend program);
-    6. paged_repeat: zero — paging adds programs once, not per request.
+    6. paged_repeat: zero — paging adds programs once, not per request;
+    7. spec_cold: the speculative family (verify window replaces the
+       decode window; ``slot_draft`` must compile NOTHING — per-site pins
+       in ``SPEC_SITE_BUDGET``);
+    8. spec_repeat: zero.
     """
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
@@ -401,13 +419,28 @@ def run_compile_census(slots: int) -> dict:
     legs["paged_cold"] = serve_one(peng, pair)
     legs["paged_repeat"] = serve_one(
         peng, [np.concatenate([shared, rand_prompt(4)]) for _ in range(2)])
+    # the speculative program family (ISSUE 9): a fresh spec engine —
+    # verify window instead of decode window, host drafting under the
+    # slot_draft site (which must compile NOTHING; see SPEC_SITE_BUDGET)
+    seng = InferenceEngine(
+        model, params, slots=slots, max_len=max_len,
+        speculative="ngram", draft_len=3,
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(16, 32),
+                                max_queue=8))
+    legs["spec_cold"] = serve_one(seng, [rand_prompt(8)])
+    legs["spec_repeat"] = serve_one(seng, [rand_prompt(10)])
     over = {name: leg["n_new_programs"] - CENSUS_BUDGET[name]
             for name, leg in legs.items()
             if leg["n_new_programs"] > CENSUS_BUDGET[name]}
+    for site, budget in SPEC_SITE_BUDGET.items():
+        n = legs["spec_cold"]["by_site"].get(site, 0)
+        if n > budget:
+            over[f"spec_cold:{site}"] = n - budget
     return {
         "legs": legs,
         "mode": tracker.mode,
         "budget": CENSUS_BUDGET,
+        "spec_site_budget": SPEC_SITE_BUDGET,
         # the regression gate: any leg over its pinned budget fails the
         # bench run (main() exits 3) — program-family growth is a perf
         # regression even when every test still passes
@@ -418,23 +451,28 @@ def run_compile_census(slots: int) -> dict:
         "repeat_compiles_zero": (
             legs["bucket16_repeat"]["n_new_programs"] == 0
             and legs["bucket32_repeat"]["n_new_programs"] == 0
-            and legs["paged_repeat"]["n_new_programs"] == 0),
+            and legs["paged_repeat"]["n_new_programs"] == 0
+            and legs["spec_repeat"]["n_new_programs"] == 0),
         "new_bucket_compiles": legs["bucket32_new"]["n_new_programs"] > 0,
     }
 
 
-def _compile_cache_probe(cache_dir: str) -> None:
+def _compile_cache_probe(cache_dir: str, prewarm: bool = False) -> None:
     """Subprocess mode (``--compile-cache-probe DIR``): build ONE engine
     with the persistent XLA compile cache at DIR, serve two requests, and
-    print the engine's compile accounting as JSON.  Run twice against the
-    same DIR by :func:`run_compile_cache`, the first call populates the
-    cache and the second measures what a warm process actually saves —
-    cross-PROCESS, which is the regression the cache exists to fix (an
-    in-process rerun would hit jax's in-memory jit cache and prove
-    nothing).  Uses the bench's PRIMARY model: the persistent cache only
-    stores programs above ``jax_persistent_cache_min_compile_time_secs``
-    (0.1 s — core/trainer._enable_compile_cache), and the toy models'
-    programs all compile under that floor, honestly measuring nothing."""
+    print the engine's compile accounting as JSON.  Run three times
+    against the same DIR by :func:`run_compile_cache`: the first call
+    populates the cache, the second measures what a warm process actually
+    saves — cross-PROCESS, which is the regression the cache exists to
+    fix (an in-process rerun would hit jax's in-memory jit cache and
+    prove nothing) — and the third (``--prewarm``) additionally calls
+    :meth:`InferenceEngine.prewarm` before submitting, measuring the
+    launch-path half of ROADMAP 5a: the first request's TTFT with every
+    compile moved before traffic.  Uses the bench's PRIMARY model: the
+    persistent cache only stores programs above
+    ``jax_persistent_cache_min_compile_time_secs`` (0.1 s —
+    core/trainer._enable_compile_cache), and the toy models' programs all
+    compile under that floor, honestly measuring nothing."""
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
         FIFOScheduler,
@@ -457,10 +495,15 @@ def _compile_cache_probe(cache_dir: str) -> None:
     # the probe exercises the cache mechanism itself (programs compile
     # lazily at first dispatch, so this lands before any compile)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    prewarm_s = None
+    if prewarm:
+        prewarm_s = eng.prewarm()["wall_s"]
     rng = np.random.default_rng(11)
+    reqs = []
     for _ in range(2):
-        eng.submit(rng.integers(1, VOCAB - 1, size=(8,)).astype(np.int32),
-                   max_new=4)
+        reqs.append(eng.submit(
+            rng.integers(1, VOCAB - 1, size=(8,)).astype(np.int32),
+            max_new=4))
     eng.run()
     s = eng.stats.summary()
     print(json.dumps({
@@ -468,6 +511,12 @@ def _compile_cache_probe(cache_dir: str) -> None:
         "compile_s": s["compile_time_s"],
         "n_programs": s["n_compiled_programs"],
         "n_cache_files": len(os.listdir(cache_dir)),
+        # first request's TTFT: with --prewarm every program was compiled
+        # before the submit, so this is pure serving latency; without, it
+        # eats the first-use compiles — the cold-vs-prewarmed delta the
+        # compile_cache block reports
+        "ttft_first_s": round(reqs[0].first_token_t - reqs[0].submit_t, 6),
+        "prewarm_s": prewarm_s,
     }), flush=True)
 
 
@@ -483,16 +532,16 @@ def run_compile_cache(timeout_s: float = 600.0) -> dict:
 
     with tempfile.TemporaryDirectory(prefix="dtm-compile-cache-") as d:
         runs = []
-        for _ in range(2):
+        for extra in ((), (), ("--prewarm",)):
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--compile-cache-probe", d],
+                 "--compile-cache-probe", d, *extra],
                 capture_output=True, text=True, timeout=timeout_s,
                 env={**os.environ, "JAX_PLATFORMS": "cpu"})
             if proc.returncode != 0:
                 return {"error": (proc.stderr or proc.stdout).strip()[-400:]}
             runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
-    cold, warm = runs
+    cold, warm, prewarmed = runs
     return {
         "cold_wall_s": cold["wall_s"],
         "warm_wall_s": warm["wall_s"],
@@ -507,6 +556,15 @@ def run_compile_cache(timeout_s: float = 600.0) -> dict:
         "cache_effective": (
             cold["n_cache_files"] > 0
             and warm["n_cache_files"] == cold["n_cache_files"]),
+        # ROADMAP 5a, the launch-path half: first-request TTFT with no
+        # prewarm (eats the engine's first-use compiles) vs with
+        # engine.prewarm() run before the first submit (every program
+        # compiled — and, here, persistent-cache-hit — before traffic)
+        "ttft_first_cold_s": cold["ttft_first_s"],
+        "ttft_first_prewarmed_s": prewarmed["ttft_first_s"],
+        "prewarm_s": prewarmed["prewarm_s"],
+        "prewarm_ttft_delta_s": round(
+            cold["ttft_first_s"] - prewarmed["ttft_first_s"], 6),
     }
 
 
@@ -609,9 +667,12 @@ def main() -> None:
                     help="internal: run one engine against the persistent "
                          "compile cache at DIR and print its compile "
                          "accounting (spawned by the compile_cache leg)")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="internal: with --compile-cache-probe, call "
+                         "engine.prewarm() before the first submit")
     args = ap.parse_args()
     if args.compile_cache_probe is not None:
-        _compile_cache_probe(args.compile_cache_probe)
+        _compile_cache_probe(args.compile_cache_probe, prewarm=args.prewarm)
         return
     if QUICK:
         args.requests = min(args.requests, 10)

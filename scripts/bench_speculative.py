@@ -1,0 +1,238 @@
+"""Speculative decoding vs plain decode-ahead on a repetitive-suffix stream.
+
+The ``speculative`` comparison block for bench.py (ISSUE 9): the SAME
+stream of repetitive-suffix requests — prompts built from a repeated
+motif, the workload prompt-lookup drafting exists for (retrieved context
+quoted back, boilerplate, code idioms) — is served twice by engines
+sharing one model:
+
+* **plain** — the decode-ahead engine at ``k = draft_len + 1``: every
+  window runs k SEQUENTIAL fused decode steps and emits k tokens (same
+  window length, same host-sync cadence — the apples-to-apples baseline);
+* **spec**  — ``speculative="ngram"``: the host drafts up to ``draft_len``
+  tokens per slot from the request's own token stream, ONE
+  (slots, k)-position verify forward accepts the longest greedy-matching
+  prefix + one correction token, and the KV cursor rewinds to the
+  acceptance point.
+
+Why spec can win at identical emitted tokens: a k-position forward is ONE
+pass over the weights (position-batched matmuls) where the decode-ahead
+scan makes k sequential single-position passes — on memory-bound decode
+that is ~k weight reads vs ~1.  Every accepted draft token converts that
+cheaper forward into MORE than one emitted token; every rejected lane
+wastes a verify position but never emits a wrong token.
+
+The comparison is HONEST the same way the serving bench is: both legs
+must produce token-for-token identical greedy output — any mismatch NULLS
+the reported speedup and the script exits nonzero (status 4), so a
+speedup bought with different tokens can never be reported.  A
+``low_repetition`` control leg (i.i.d. random prompts) is measured
+alongside: its accept rate collapses and its speedup hovers near (often
+below) 1x, which is the documented floor, not a failure.
+
+Designed to run in a SUBPROCESS (bench.py spawns it with
+``JAX_PLATFORMS=cpu``; ``DTM_BENCH_SKIP_SPEC=1`` skips the phase) and
+self-arms when run directly:
+
+    python scripts/bench_speculative.py [--requests 16] [--slots 4]
+
+Prints ONE JSON line (``"metric": "speculative"``).
+``DTM_BENCH_QUICK=1`` shrinks the stream for CI smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+QUICK = os.environ.get("DTM_BENCH_QUICK", "") not in ("", "0")
+
+# the regime speculative decoding targets: per-position decode COMPUTE
+# dominates the host loop — one k-position verify forward makes ONE pass
+# over the weights where the decode-ahead scan makes k, and on this host
+# class the k=8 window-vs-verify cost ratio only clears ~2x from dim-320
+# depth-6 up (measured: 1.2x at dim-96, 1.3x at dim-192, 2.0x at
+# dim-320).  The dispatch-taxed dim-32 toy regime belongs to the
+# decode-ahead leg of bench_serving.py, not here; QUICK trades headroom
+# for runtime and may land under target (the record says so).
+DIM, DEPTH, HEADS, VOCAB = (192, 4, 8, 32) if QUICK else (320, 6, 8, 32)
+BUCKET = 32
+# long enough that the steady periodic phase (where prompt-lookup locks
+# onto the generated cycle and accepts whole drafts) amortizes the first
+# windows' transient, where the model is still diverging from the prompt
+# motif and drafts mostly miss
+MAX_NEW = 48 if QUICK else 64
+DRAFT_LEN = 7  # k = 8 verify positions per window
+
+
+def make_stream(n_requests: int, seed: int, repetitive: bool):
+    """``repetitive``: each prompt is a short random motif tiled to the
+    bucket — the suffix n-gram always has a prior occurrence, so
+    prompt-lookup drafts the motif's continuation (and, once generation
+    falls into the model's greedy attractor, its own recent output).
+    Otherwise: i.i.d. random prompts — the low-repetition control."""
+    rng = np.random.default_rng(seed)
+    stream = []
+    for _ in range(n_requests):
+        if repetitive:
+            motif = rng.integers(1, VOCAB - 1,
+                                 size=(int(rng.integers(4, 9)),))
+            reps = int(np.ceil(28 / motif.size))
+            prompt = np.tile(motif, reps)[:28].astype(np.int32)
+        else:
+            n = int(rng.integers(16, 29))
+            prompt = rng.integers(1, VOCAB - 1, size=(n,)).astype(np.int32)
+        stream.append((prompt, MAX_NEW))
+    return stream
+
+
+def serve(model, params, stream, slots: int, max_len: int, warm, **kw):
+    """One engine, warmed outside the timed region, then the stream timed.
+    Returns (elapsed_s, per-request outputs, per-request decode latency
+    mean, stats summary)."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+        ServingStats,
+    )
+
+    eng = InferenceEngine(
+        model, params, slots=slots, max_len=max_len,
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET,),
+                                max_queue=max(len(stream), len(warm))),
+        **kw)
+    for p, mn in warm:
+        eng.submit(p, max_new=mn)
+    eng.run()
+    eng.completed.clear()
+    eng.stats = ServingStats(slots, decode_ahead=eng.decode_ahead)
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new=mn) for p, mn in stream]
+    eng.run()
+    elapsed = time.perf_counter() - t0
+    outs = [np.asarray(r.generated) for r in reqs]
+    # per-request decode latency: first token to retirement (prefill and
+    # queue wait excluded — the window loop is what speculation changes)
+    decode_lat = float(np.mean([r.finish_t - r.first_token_t for r in reqs]))
+    summ = eng.stats.summary()
+    eng.close()
+    return elapsed, outs, decode_lat, summ
+
+
+def run_pair(model, params, stream, warm, slots: int, max_len: int) -> dict:
+    """plain decode-ahead (k = DRAFT_LEN+1) vs speculative on one stream;
+    refuses to report a speedup over mismatched output."""
+    k = DRAFT_LEN + 1
+    pl_s, pl_out, pl_lat, pl_summ = serve(
+        model, params, stream, slots, max_len, warm, decode_ahead=k)
+    sp_s, sp_out, sp_lat, sp_summ = serve(
+        model, params, stream, slots, max_len, warm,
+        speculative="ngram", draft_len=DRAFT_LEN)
+    mismatches = sum(not np.array_equal(a, b)
+                     for a, b in zip(pl_out, sp_out))
+    useful = sum(o.size for o in pl_out)
+    speedup = (useful / sp_s) / (useful / pl_s)
+    lat_ratio = pl_lat / sp_lat if sp_lat > 0 else None
+    return {
+        "n_requests": len(stream),
+        "useful_tokens": useful,
+        "output_mismatches": mismatches,  # MUST be 0 (greedy parity)
+        "plain": {
+            "decode_ahead": k,
+            "elapsed_s": round(pl_s, 4),
+            "tokens_per_sec": round(useful / pl_s, 2),
+            "decode_latency_s_mean": round(pl_lat, 4),
+            "n_windows": pl_summ["n_windows"],
+            "useful_tokens_per_window": pl_summ["useful_tokens_per_window"],
+        },
+        "spec": {
+            "draft_len": DRAFT_LEN,
+            "elapsed_s": round(sp_s, 4),
+            "tokens_per_sec": round(useful / sp_s, 2),
+            "decode_latency_s_mean": round(sp_lat, 4),
+            "n_windows": sp_summ["n_windows"],
+            "useful_tokens_per_window": sp_summ["useful_tokens_per_window"],
+            "drafted_tokens": sp_summ["drafted_tokens"],
+            "accepted_tokens": sp_summ["accepted_tokens"],
+            "accept_rate": sp_summ["accept_rate"],
+        },
+        # the headline: sustained useful tokens/sec, spec over plain, on
+        # IDENTICAL output — nulled on any mismatch
+        "speedup": None if mismatches else round(speedup, 3),
+        "decode_latency_ratio": (
+            None if mismatches or lat_ratio is None else round(lat_ratio, 3)),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=12 if QUICK else 16)
+    ap.add_argument("--slots", type=int, default=4)
+    args = ap.parse_args()
+
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+
+    max_len = BUCKET + MAX_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DIM, depth=DEPTH,
+                      heads=HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    warm = make_stream(max(args.slots, 4), seed=1, repetitive=True)
+
+    rep = run_pair(model, params,
+                   make_stream(args.requests, seed=2, repetitive=True),
+                   warm, args.slots, max_len)
+    # low-repetition control: accept rate collapses, speedup ~1x or below
+    # — measured and reported, never averaged into the headline
+    low = run_pair(model, params,
+                   make_stream(max(args.requests // 2, 4), seed=3,
+                               repetitive=False),
+                   warm, args.slots, max_len)
+
+    result = {
+        "metric": "speculative",
+        "model": {"dim": DIM, "depth": DEPTH, "heads": HEADS,
+                  "vocab": VOCAB},
+        "slots": args.slots,
+        "max_new": MAX_NEW,
+        "draft_len": DRAFT_LEN,
+        "repetitive": rep,
+        "low_repetition": low,
+        "speedup": rep["speedup"],
+        "target_speedup": 1.3,
+        "meets_target": (rep["speedup"] is not None
+                         and (rep["speedup"] >= 1.3
+                              or (rep["decode_latency_ratio"] or 0) >= 1.3)),
+        "quick": QUICK,
+        "device": str(jax.devices()[0]),
+        "note": (
+            "speedup is spec-over-plain useful tokens/sec at identical "
+            "greedy output (mismatches null it; exit 4); the "
+            "low_repetition control documents the honest floor — without "
+            "repeated suffixes prompt-lookup accepts little and spec "
+            "pays its verify overhead for ~nothing"
+        ),
+    }
+    print(json.dumps(result), flush=True)
+    if rep["output_mismatches"] or low["output_mismatches"]:
+        print(f"speculative parity BREACH: repetitive="
+              f"{rep['output_mismatches']} low={low['output_mismatches']} "
+              f"mismatched request(s) — speculative output must be "
+              f"token-identical to plain greedy decode", file=sys.stderr)
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

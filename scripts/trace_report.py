@@ -12,7 +12,10 @@ viewer-independent identity every exported span carries), and prints
 * a **per-request rollup** (when ``request`` root spans are present) —
   per request: status, bucket, total latency, and the child-phase split,
   plus the unattributed remainder (root minus sum of child phases —
-  scheduler hand-off and host-loop slack live there);
+  scheduler hand-off and host-loop slack live there).  When the trace
+  carries speculative-decoding spans (ISSUE 9), each request also rolls
+  up its summed draft/verify/accept milliseconds and an ``accept_rate``
+  column (accepted/drafted over the request's verify windows);
 * the **instant and counter digest** — faults, restarts, cache hits, and
   last counter values, so a soak's timeline is summarized without a GUI.
 
@@ -86,6 +89,47 @@ def analyze(doc: dict) -> dict:
         if parent is not None:
             children.setdefault(parent, []).append(e)
 
+    def _owning_request(e: dict, depth: int = 8) -> int | None:
+        """Follow ``args.parent`` links up to the ``request`` root span
+        (speculative draft/verify/accept spans parent on the request's
+        open PHASE span, one level below the root)."""
+        while depth > 0:
+            parent = (e.get("args") or {}).get("parent")
+            if parent is None or parent not in by_id:
+                return None
+            e = by_id[parent]
+            if e["name"] == "request":
+                return (e.get("args") or {}).get("id")
+            depth -= 1
+        return None
+
+    # speculative-decoding rollup (ISSUE 9): per request, the summed
+    # draft/verify/accept time and the acceptance counters the engine
+    # stamps on each window's `accept` span
+    spec_by_req: dict[int, dict] = {}
+    for e in spans:
+        if e.get("cat") != "speculative":
+            continue
+        rid = _owning_request(e)
+        if rid is None:
+            continue
+        d = spec_by_req.setdefault(rid, {
+            "draft_ms": 0.0, "verify_ms": 0.0, "accept_ms": 0.0,
+            "windows": 0, "drafted": 0, "accepted": 0})
+        key = f"{e['name']}_ms"
+        if key in d:
+            d[key] += e.get("dur", 0) / 1e3
+        if e["name"] == "accept":
+            a = e.get("args") or {}
+            d["windows"] += 1
+            d["drafted"] += int(a.get("drafted", 0))
+            d["accepted"] += int(a.get("accepted", 0))
+    for d in spec_by_req.values():
+        for key in ("draft_ms", "verify_ms", "accept_ms"):
+            d[key] = round(d[key], 3)
+        d["accept_rate"] = (round(d["accepted"] / d["drafted"], 4)
+                            if d["drafted"] > 0 else None)
+
     requests = []
     for e in spans:
         if e["name"] != "request":
@@ -95,14 +139,19 @@ def analyze(doc: dict) -> dict:
         split = {}
         for c in children.get(args.get("id"), []):
             split[c["name"]] = round(split.get(c["name"], 0.0) + c.get("dur", 0) / 1e3, 3)
-        requests.append({
+        row = {
             "req": args.get("req"),
             "status": args.get("status"),
             "bucket": args.get("bucket"),
             "total_ms": round(total_ms, 3),
             "phases_ms": split,
             "other_ms": round(total_ms - sum(split.values()), 3),
-        })
+        }
+        spec = spec_by_req.get(args.get("id"))
+        if spec is not None:
+            row["speculative"] = spec
+            row["accept_rate"] = spec["accept_rate"]
+        requests.append(row)
     requests.sort(key=lambda r: (r["req"] is None, r["req"]))
 
     # --- instants / counters ---------------------------------------------
@@ -178,14 +227,18 @@ def main(argv: list[str] | None = None) -> int:
                       "p95_ms", "max_ms"]))
     if report["requests"]:
         print("\nPer-request rollup (ms):")
+        spec_any = any("speculative" in r for r in report["requests"])
         rows = [
             {**{k: r[k] for k in ("req", "status", "bucket", "total_ms",
                                   "other_ms")},
-             "phases": " ".join(f"{k}={v}" for k, v in r["phases_ms"].items())}
+             "phases": " ".join(f"{k}={v}" for k, v in r["phases_ms"].items()),
+             **({"accept_rate": r.get("accept_rate")} if spec_any else {})}
             for r in report["requests"]
         ]
-        print(_fmt_table(rows, ["req", "status", "bucket", "total_ms",
-                                "phases", "other_ms"]))
+        cols = ["req", "status", "bucket", "total_ms", "phases", "other_ms"]
+        if spec_any:
+            cols.append("accept_rate")
+        print(_fmt_table(rows, cols))
     if report["instants"]:
         print("\nInstant events:")
         for k, v in report["instants"].items():
