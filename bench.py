@@ -30,7 +30,16 @@ and manifest-cost guards — scripts/chaos_soak.py, skip with
 DTM_BENCH_SKIP_CHAOS), and a ``speculative`` block (ISSUE 9: n-gram
 prompt-lookup drafting + verify-window decode vs plain decode-ahead on a
 repetitive-suffix stream, greedy parity enforced —
-scripts/bench_speculative.py, skip with DTM_BENCH_SKIP_SPEC).
+scripts/bench_speculative.py, skip with DTM_BENCH_SKIP_SPEC), and a
+``tp_serving`` block (ISSUE 10: tensor-parallel serving at tp ∈ {1,2,4} —
+per-chip bytes pinned at 1/tp, the dense/paged x int8 x decode_ahead x
+speculative parity cross token-identical across tp, a failover replay
+over disjoint tp groups — scripts/bench_tp_serving.py, skip with
+DTM_BENCH_SKIP_TP), and a ``train_census`` block (ROADMAP 5a: per-path
+pinned compile budgets for Trainer.fit()'s program family —
+scripts/bench_train_census.py, skip with DTM_BENCH_SKIP_TRAIN_CENSUS).
+The tp_serving and train_census gates fail the bench run (exit 3) on
+breach, after the record prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -340,6 +349,53 @@ def main() -> None:
 
             print(f"bench: kv_paging phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 5c — tensor-parallel serving (ISSUE 10): a model exceeding one
+    # (synthetic) chip's budget served at tp ∈ {1,2,4} — per-chip weight +
+    # KV bytes pinned at 1/tp (±10%), the full dense/paged x int8 x
+    # decode_ahead x speculative parity cross token-identical across tp,
+    # and a 2-replica x 2-chip-group router failover replay.  Runs
+    # scripts/bench_tp_serving.py in a SUBPROCESS on an 8-device virtual
+    # CPU platform.  Skippable (DTM_BENCH_SKIP_TP); a memory/parity/
+    # failover gate breach FAILS the bench run (exit 3) after the record
+    # prints — sharding that changes tokens or misses its memory claim is
+    # a regression, not a caveat.
+    tp_serving = None
+    tp_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_TP"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)  # the script arms its own devices
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_tp_serving.py")],
+                capture_output=True, text=True, timeout=580, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "tp_serving":
+                    tp_serving = rec
+            if tp_serving is None or out.returncode != 0:
+                tp_gate_rc = out.returncode or 1
+                print(
+                    f"bench: tp_serving subprocess "
+                    f"{'produced no record' if tp_serving is None else 'FAILED (memory/parity/failover gate)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            tp_gate_rc = 1
+            print(f"bench: tp_serving phase failed: {e!r}", file=sys.stderr)
+
     # Phase 6 — the chaos soak (ISSUE 3): seeded multi-fault plans against
     # training (torn checkpoint write, NaN step, checkpoint-read + data-
     # batch I/O faults -> bit-identical recovery) and serving (poisoned
@@ -463,6 +519,52 @@ def main() -> None:
 
             print(f"bench: speculative phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 9 — the training-side compile census (ROADMAP 5a remainder):
+    # Trainer.fit() now labels its compile sites with the parallelism
+    # path (train_epoch[dp4_fsdp], h2d[dp1_stream], ...) and reports
+    # compile_by_site; scripts/bench_train_census.py runs one tiny fit
+    # per path (dp1, stream, dp4, fsdp, sharded_update, dp2 x pp2) and
+    # pins every path's per-site program counts.  A breach FAILS the
+    # bench run (exit 3) after the record prints.  Skippable
+    # (DTM_BENCH_SKIP_TRAIN_CENSUS); runs in a SUBPROCESS on an
+    # 8-device virtual CPU platform.
+    train_census = None
+    census_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_TRAIN_CENSUS"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)  # the script arms its own devices
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_train_census.py")],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "train_census":
+                    train_census = rec
+            if train_census is None or out.returncode != 0:
+                census_gate_rc = out.returncode or 1
+                print(
+                    f"bench: train_census subprocess "
+                    f"{'produced no record' if train_census is None else 'FAILED (program-count budget breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            census_gate_rc = 1
+            print(f"bench: train_census phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -549,6 +651,14 @@ def main() -> None:
         result["speculative"] = {
             k: v for k, v in speculative.items() if k != "metric"
         }
+    if tp_serving is not None:
+        result["tp_serving"] = {
+            k: v for k, v in tp_serving.items() if k != "metric"
+        }
+    if train_census is not None:
+        result["train_census"] = {
+            k: v for k, v in train_census.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -557,6 +667,13 @@ def main() -> None:
     result["compile_time_s"] = cdelta["compile_time_s"]
     result["compile_by_site"] = cdelta["by_site"]
     print(json.dumps(result), flush=True)
+    # the hard gates (tp memory/parity/failover, train compile census)
+    # fail the RUN, not just their block — after the record prints so the
+    # numbers are never lost with the verdict
+    if tp_gate_rc or census_gate_rc:
+        import sys
+
+        sys.exit(3)
 
 
 if __name__ == "__main__":

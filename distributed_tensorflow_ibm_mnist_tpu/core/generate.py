@@ -452,11 +452,18 @@ def make_verify_window(model, max_len: int, draft_len: int,
     return verify
 
 
-def init_cache(model, params, batch: int, max_len: int):
+def init_cache(model, params, batch: int, max_len: int, shardings=None):
     """A zeroed (batch, max_len) decode-cache pytree in the model's decode
     layout (same structure/dtypes a real prefill produces) — the serving
     engine's slot cache before any request is admitted.  Built from
     ``jax.eval_shape`` of the decode apply, so no forward pass runs.
+
+    ``shardings``: optional congruent NamedSharding tree (the tensor-
+    parallel engine's head-axis KV layout).  When given, the zeros are
+    materialized DIRECTLY under those shardings (a jit with
+    ``out_shardings``), so a cache bigger than one chip's memory never
+    transits a single device — the allocation path of serving models
+    that only exist sharded.
 
     DENSE layout only: a paged model (``page_size > 0``) decodes through a
     shared page pool whose size is serving configuration, not a model
@@ -467,14 +474,33 @@ def init_cache(model, params, batch: int, max_len: int):
             "paged model (page_size > 0) decodes through a page pool — "
             "build it with serving.kv_pool.init_paged_cache, which also "
             "sizes the pool (n_pages is engine config)")
-    shapes = jax.eval_shape(
+    return _zeros_like_shapes(
+        cache_shapes(model, params, batch, max_len), shardings)
+
+
+def cache_shapes(model, params, batch: int, max_len: int):
+    """ShapeDtypeStruct tree of the dense (batch, max_len) decode cache —
+    the probe :func:`init_cache` allocates from, exposed so a caller that
+    needs a CONGRUENT tree before allocation (the tensor-parallel engine
+    building its head-axis sharding tree) can derive one without running
+    a forward pass."""
+    return jax.eval_shape(
         lambda p: model.apply(
             {"params": p}, jnp.zeros((batch, 1), jnp.int32),
             decode=True, max_len=max_len, ragged=True, mutable=["cache"],
         )[1]["cache"],
         params,
     )
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def _zeros_like_shapes(shapes, shardings=None):
+    """Zeros for an eval_shape tree — placed per ``shardings`` when given
+    (each chip materializes only its own shard), default-device otherwise."""
+    build = lambda: jax.tree.map(  # noqa: E731 - tiny local thunk
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    if shardings is None:
+        return build()
+    return jax.jit(build, out_shardings=shardings)()
 
 
 def _filter_logits(logits, top_k: int, top_p: float):

@@ -451,6 +451,25 @@ class Trainer:
                 "input_mode='stream' does not compose with tp/sp/pp/fsdp/"
                 "expert parallelism; use device mode"
             )
+        # Compile-census path label: every parallelism knob that changes
+        # WHICH programs fit() compiles gets a token, so by-site compile
+        # attribution distinguishes e.g. train_epoch[dp4_fsdp] from
+        # train_epoch[dp4] and the census gate
+        # (scripts/bench_train_census.py) can pin per-path budgets.
+        _parts = [f"dp{self.dp}"]
+        if config.fsdp:
+            _parts.append("fsdp")
+        if self.tp > 1:
+            _parts.append(f"tp{self.tp}")
+        if self.sp > 1:
+            _parts.append(f"sp{self.sp}")
+        if self.pp > 1:
+            _parts.append(f"pp{self.pp}")
+        if config.sharded_update:
+            _parts.append("su")
+        if self._stream:
+            _parts.append("stream")
+        self._path_label = "_".join(_parts)
         if self.pp > 1:
             m = config.pp_microbatches or self.pp
             if config.batch_size % (self.dp * m):
@@ -975,10 +994,13 @@ class Trainer:
             pending_labs.clear()
             span = (tracer.begin("h2d", cat="train", steps=chunk)
                     if tracer is not None else None)
-            if self._chunk_shardings is not None:
-                out = jax.device_put(batch, self._chunk_shardings)
-            else:
-                out = jax.device_put(batch)
+            # innermost site wins: transfer-program compiles land on the
+            # h2d site, not the enclosing train_epoch site
+            with self._compile.site(f"h2d[{self._path_label}]"):
+                if self._chunk_shardings is not None:
+                    out = jax.device_put(batch, self._chunk_shardings)
+                else:
+                    out = jax.device_put(batch)
             if span is not None:
                 tracer.end(span)  # enqueue time; the transfer itself is async
             return out
@@ -1001,10 +1023,11 @@ class Trainer:
             batch = {"image": img, "label": lab}
             span = (tracer.begin("h2d", cat="train", steps=1)
                     if tracer is not None else None)
-            if self._step_shardings is not None:
-                batch = jax.device_put(batch, self._step_shardings)
-            else:
-                batch = jax.device_put(batch)
+            with self._compile.site(f"h2d[{self._path_label}]"):
+                if self._step_shardings is not None:
+                    batch = jax.device_put(batch, self._step_shardings)
+                else:
+                    batch = jax.device_put(batch)
             if span is not None:
                 tracer.end(span)
                 span = tracer.begin("dispatch", cat="train", steps=1)
@@ -1538,7 +1561,7 @@ class Trainer:
                                             epoch=epoch)
                          if self._tracer is not None else None)
                 try:
-                    with self._compile.site("train_epoch"):
+                    with self._compile.site(f"train_epoch[{self._path_label}]"):
                         if self._stream:
                             self.state, metrics = self._run_epoch_stream(
                                 self.state, epoch_rng, preemption=preemption)
@@ -1637,7 +1660,7 @@ class Trainer:
                         vspan = (self._tracer.begin("eval", cat="train",
                                                     epoch=ep)
                                  if self._tracer is not None else None)
-                        with self._compile.site("eval"):
+                        with self._compile.site(f"eval[{self._path_label}]"):
                             ev = self.evaluate()
                         if vspan is not None:
                             self._tracer.end(vspan)
@@ -1699,6 +1722,10 @@ class Trainer:
         cdelta = CompileTracker.delta(self._compile.snapshot(), compile0)
         summary["n_compiled_programs"] = cdelta["n_compiled_programs"]
         summary["compile_time_s"] = round(cdelta["compile_time_s"], 3)
+        # path-qualified site attribution (train_epoch[...]/eval[...]/
+        # h2d[...]) — the per-path census scripts/bench_train_census.py
+        # budgets against; strict JSON (plain dicts, ints, floats)
+        summary["compile_by_site"] = cdelta["by_site"]
         tokens = self._tokens_per_sec(images / steady_mean / chips) if steady_mean else None
         if tokens is not None:
             summary["tokens_per_sec_per_chip"] = tokens

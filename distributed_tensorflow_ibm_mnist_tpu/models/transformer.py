@@ -16,6 +16,18 @@ Parallelism hooks:
 * MLP sublayers are named ``dense_0``/``dense_1``, so the Megatron
   alternating TP rule (parallel/tensor_parallel.py) shards them over
   ``model`` with one reduction per block.
+* The decode-cache leaves this module sows — dense ``k``/``v``
+  ``(B, max_len, H_kv, D)`` slabs (+ int8 ``k_scale``/``v_scale``
+  ``(B, max_len, H_kv)``) and paged ``pages_k``/``pages_v``
+  ``(n_pages, page_size, H_kv, D)`` pools — all carry the KV-HEAD axis at
+  a fixed position, which is what the SERVING tensor-parallel path shards
+  (``kv_cache_rule`` in parallel/tensor_parallel.py: heads split over the
+  ``tp`` mesh axis, block tables/cursors replicated).  Nothing in this
+  module is mesh-aware: under ``InferenceEngine(tp=N)`` the same decode
+  code runs SPMD with q/kv projections column-sharded, each chip
+  attending over its own H/tp heads against its own cache shard, and one
+  psum per attention block (the row-sharded out-projection) — so cache
+  layout changes here must keep the head axis intact per leaf.
 
 Compute in ``dtype`` (bf16 default, MXU-friendly); params and logits f32.
 """

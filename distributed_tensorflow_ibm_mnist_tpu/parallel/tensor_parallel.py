@@ -21,6 +21,7 @@ import re
 from typing import Any, Callable
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_ibm_mnist_tpu.core.state import TrainState
@@ -266,6 +267,121 @@ def _tp_shardings(mesh: Mesh, state: TrainState, param_specs, data_axis: str,
     lab_shard = NamedSharding(mesh, P(data_axis))
     metric_shard = NamedSharding(mesh, P())
     return st_shard, img_shard, lab_shard, metric_shard
+
+
+# ----------------------------------------------------------------------
+# serving-side tensor parallelism (ROADMAP item 5b, ISSUE 10)
+#
+# The SAME Megatron rule that shards the train step shards the serving
+# decode: the engine jits its unchanged program family (prefill, decode/
+# verify windows, insert/reset/extend) against params placed by
+# ``megatron_rule`` over a one-axis ``tp`` mesh, and the partitioner
+# derives the one-psum-per-attention / one-psum-per-MLP schedule from the
+# column->row alternation alone.  What IS new here is the KV cache rule:
+# every cache slab — dense ``(slots, max_len, H_kv, D)`` rows and paged
+# ``(n_pages, page_size, H_kv, D)`` pools alike — shards over the HEAD
+# axis, the decode analog of sharding the kv projection's output
+# features.  Cursors and block tables stay replicated: the host-side
+# allocator (serving/kv_pool.py) works in whole pages and never sees the
+# head axis, which is what keeps allocation decisions layout-invariant at
+# any ``tp``.
+
+# cache leaves that carry a head axis (dim -2 of the 4-D slabs); the int8
+# layout splits each into a payload + a trailing-head-axis scale
+_KV_HEAD_LEAVES = ("k", "v", "pages_k", "pages_v")
+_KV_SCALE_LEAVES = ("k_scale", "v_scale", "pages_k_scale", "pages_v_scale")
+
+
+def kv_cache_rule(n_shards: int, axis: str = "tp") -> SpecRule:
+    """Spec rule for a decode-cache pytree: KV slabs shard over the head
+    axis, everything else (cursors, block tables) replicates.
+
+    Works on BOTH layouts — dense ``k``/``v`` ``(B, max_len, H_kv, D)``
+    slot rows (and the B=1 prefill row caches the insert program
+    consumes) and paged ``pages_k``/``pages_v`` ``(n_pages, page_size,
+    H_kv, D)`` pools — plus their int8 ``*_scale`` companions, whose
+    LAST axis is the head axis.  Divisibility degrades to replicated,
+    the same guard :func:`megatron_rule` applies to params (an engine
+    that wants the 1/tp memory claim should validate ``tp | heads_kv``
+    up front instead of relying on the degrade)."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+
+    def rule(path: tuple[str, ...], leaf) -> P:
+        name = path[-1] if path else ""
+        shape = getattr(leaf, "shape", ())
+        if (name in _KV_HEAD_LEAVES and len(shape) == 4
+                and shape[2] % n_shards == 0):
+            return P(None, None, axis, None)
+        if (name in _KV_SCALE_LEAVES and len(shape) == 3
+                and shape[2] % n_shards == 0):
+            return P(None, None, axis)
+        return P()
+
+    return rule
+
+
+def serving_mesh(tp: int, devices=None) -> Mesh:
+    """A one-axis ``("tp",)`` mesh over ``tp`` devices for the serving
+    decode path.  ``devices`` defaults to the first ``tp`` of
+    ``jax.devices()``; a router composing replicas x disjoint TP groups
+    passes each replica its own slice (:func:`tp_device_groups`)."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    devs = list(devices) if devices is not None else jax.devices()[:tp]
+    if len(devs) != tp:
+        raise ValueError(
+            f"serving_mesh(tp={tp}) needs exactly {tp} devices, got "
+            f"{len(devs)} (of {len(jax.devices())} visible) — on CPU, arm "
+            "emulated chips first via utils.hostmesh."
+            "ensure_virtual_cpu_devices(n)")
+    arr = np.empty((tp,), dtype=object)
+    arr[:] = devs
+    return Mesh(arr, ("tp",))
+
+
+def tp_device_groups(n_groups: int, tp: int, devices=None) -> list[list]:
+    """Partition ``devices`` (default: all visible) into ``n_groups``
+    DISJOINT groups of ``tp`` — the replica-factory seam for a router
+    serving N tensor-parallel replicas: replica ``i`` builds its engine
+    with ``tp_devices=groups[i]`` so failover/hot-swap never shares a
+    chip between failure domains."""
+    devs = list(devices) if devices is not None else jax.devices()
+    need = n_groups * tp
+    if len(devs) < need:
+        raise ValueError(
+            f"tp_device_groups({n_groups}, {tp}) needs {need} devices, "
+            f"got {len(devs)}")
+    return [devs[i * tp:(i + 1) * tp] for i in range(n_groups)]
+
+
+def mesh_shardings(mesh: Mesh, specs):
+    """PartitionSpec tree -> congruent NamedSharding tree on ``mesh``."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def per_chip_bytes(tree, device=None) -> int:
+    """Bytes of ``tree`` resident on ONE chip: the sum over leaves of the
+    shard bytes held by ``device`` (default: the first leaf's first
+    shard's device).  A leaf sharded ``n`` ways contributes ``nbytes/n``;
+    a replicated leaf contributes its full ``nbytes`` — which is exactly
+    the per-chip HBM a serving config has to fit, and the figure
+    ``ServingStats`` reports as ``kv_bytes_per_chip`` /
+    ``weight_bytes_per_chip``.  Host (numpy) leaves count whole."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            total += int(getattr(leaf, "nbytes", 0))
+            continue
+        if device is None:
+            device = shards[0].device
+        total += sum(int(s.data.nbytes) for s in shards
+                     if s.device == device)
+    return total
 
 
 def make_tp_epoch_runner(

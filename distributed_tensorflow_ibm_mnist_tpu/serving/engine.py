@@ -130,16 +130,25 @@ from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
     _decode_window_core,
     _filter_logits,
     _verify_window_core,
-    init_cache,
+    _zeros_like_shapes,
+    cache_shapes,
     make_prefill,
 )
 from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_slots
+from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+    kv_cache_rule,
+    make_param_specs,
+    megatron_rule,
+    mesh_shardings,
+    per_chip_bytes,
+    serving_mesh,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.drafter import NgramDrafter
 from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
     KVPagePool,
-    init_paged_cache,
     make_paged_extend,
     make_paged_insert,
+    paged_cache_shapes,
     paged_reset,
     pages_needed,
     pool_page_bytes,
@@ -195,6 +204,21 @@ class InferenceEngine:
     program) and occupies ZERO extra pages.  Greedy paged output is
     token-identical to the dense engine for every ``decode_ahead``.
 
+    ``tp=N`` shards the WHOLE program family over an N-chip ``("tp",)``
+    mesh (parallel/tensor_parallel.py ``serving_mesh``): weights
+    column/row-split by the same Megatron rule the training mesh uses
+    (q/kv/up column, proj/down row — one psum per attention block and one
+    per MLP per layer), the KV cache split over the HEAD axis in both
+    layouts, per-chip weight and KV bytes 1/tp — a model whose bf16
+    weights + pool exceed one chip serves anyway.  ``tp_devices=`` picks
+    the chips (default: the first N visible; a router passes each replica
+    its own disjoint group).  ``tp`` must divide ``heads`` AND
+    ``heads_kv``.  Everything host-side — scheduler, page pool, radix
+    trie, prefix keys, the n-gram drafter — never sees the mesh, so
+    allocation/admission decisions and greedy output are tp-invariant
+    (pinned in tests/test_tp_serving.py), and ``swap_params`` re-shards a
+    full host tree onto the engine's own mesh.
+
     Sampling knobs mirror ``make_generator`` (greedy at ``temperature=0``;
     ``rng`` required otherwise — per-step keys are split from it).
     ``tracer=`` (utils/tracing.Tracer) records a span tree per request and
@@ -224,6 +248,7 @@ class InferenceEngine:
                  prefix_cache_bytes: int = 0,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  radix_cache: bool | None = None,
+                 tp: int = 1, tp_devices=None,
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, writer: MetricWriter | None = None,
@@ -305,6 +330,18 @@ class InferenceEngine:
                     "the paged cache does not compose with sliding-window "
                     "attention (model.window > 0) — the windowed decode "
                     "gathers a contiguous dense span")
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if tp > 1:
+            heads = getattr(model, "heads", 0)
+            heads_kv = getattr(model, "heads_kv", None) or heads
+            if not heads or heads % tp or heads_kv % tp:
+                raise ValueError(
+                    f"tp={tp} must divide heads ({heads}) and heads_kv "
+                    f"({heads_kv}): the Megatron column/row split and the "
+                    "KV head-axis shard both partition WHOLE heads — a "
+                    "silent replicated degrade would void the 1/tp "
+                    "per-chip memory claim")
         # persistent XLA compilation cache (opt-in): warm processes skip
         # recompiling the engine's program family — the r04→r05 cold-start
         # regression lever.  Semantics per core/trainer.resolve_compile_
@@ -316,6 +353,31 @@ class InferenceEngine:
             )
 
             _enable_compile_cache(compile_cache_dir)
+        # --- tensor-parallel mesh (tp=1: every attribute None, the whole
+        # path byte-identical to the single-chip engine) --- the serving
+        # half of ROADMAP item 5b: weights column/row-sharded by the SAME
+        # Megatron rule the training mesh uses, KV cache sharded over the
+        # head axis, one psum per attention block and one per MLP inserted
+        # by the partitioner at the column->row boundaries.  Everything
+        # host-side (scheduler, pool, radix trie, drafter) never sees the
+        # mesh — allocation decisions are identical at any tp.
+        self.tp = int(tp)
+        if tp > 1:
+            self._mesh = serving_mesh(tp, tp_devices)
+            self._kv_rule = kv_cache_rule(tp, axis="tp")
+            self._param_shardings = mesh_shardings(
+                self._mesh,
+                make_param_specs(params, megatron_rule(tp, axis="tp")))
+            # accepts a host or single-chip tree and re-shards wholesale —
+            # the same seam swap_params reuses for hot-swap under tp
+            params = jax.device_put(params, self._param_shardings)
+            self._rep = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
+        else:
+            self._mesh = None
+            self._kv_rule = None
+            self._param_shardings = None
+            self._rep = None
         self.model = model
         self.params = params
         self.slots = slots
@@ -418,14 +480,36 @@ class InferenceEngine:
             decode_model = model
         self._kv_pages = int(kv_pages)
 
+        # every jitted program that RETURNS a cache pins the KV layout at
+        # its output (identity at tp=1): GSPMD propagation from the
+        # committed sharded inputs would usually land there anyway, but the
+        # pin makes the head-axis layout an explicit program invariant —
+        # every program's cache OUTPUT is layout-identical to every
+        # program's cache INPUT, which is what keeps donation legal and
+        # the compile census at ONE program per (site, shape-key) under tp
+        if self._mesh is not None:
+            def _pin(tree):
+                return jax.lax.with_sharding_constraint(
+                    tree, mesh_shardings(
+                        self._mesh, make_param_specs(tree, self._kv_rule)))
+        else:
+            def _pin(tree):
+                return tree
+        self._pin_kv = _pin
+
         self._prefill = make_prefill(model, max_len)     # per-bucket shapes
         if kv_page_size:
-            self._insert = jax.jit(
-                make_paged_insert(kv_page_size, max_len), donate_argnums=(0,))
-            self._reset = jax.jit(paged_reset, donate_argnums=(0,))
+            _insert_fn = make_paged_insert(kv_page_size, max_len)
+            _reset_fn = paged_reset
         else:
-            self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-            self._reset = jax.jit(reset_cache_slots, donate_argnums=(0,))
+            _insert_fn = self._insert_impl
+            _reset_fn = reset_cache_slots
+        self._insert = jax.jit(
+            lambda cache, *a: _pin(_insert_fn(cache, *a)),
+            donate_argnums=(0,))
+        self._reset = jax.jit(
+            lambda cache, mask: _pin(_reset_fn(cache, mask)),
+            donate_argnums=(0,))
 
         def _pick(logits, rng):
             if temperature == 0.0:
@@ -443,9 +527,10 @@ class InferenceEngine:
             # exactly the old fused step+pick (a scan of length 1), so the
             # classic loop and the windowed loop are the same program
             # family, not two code paths that can drift
-            return _decode_window_core(
+            cache, blk, last = _decode_window_core(
                 decode_model, params, cache, tok, active, rngs, max_len,
                 True, _pick, pad_id_)
+            return _pin(cache), blk, last
 
         self._window = jax.jit(_window_impl, donate_argnums=(1,))
 
@@ -458,9 +543,10 @@ class InferenceEngine:
             # per-window dispatch: drafting happens on the host between
             # windows, which a fused k-step scan could never pause for.
             def _verify_impl(params, cache, chunk, draft_lens, active):
-                return _verify_window_core(
+                cache, *rest = _verify_window_core(
                     decode_model, params, cache, chunk, draft_lens, active,
                     max_len, pad_id_)
+                return (_pin(cache), *rest)
 
             self._verify = jax.jit(_verify_impl, donate_argnums=(1,))
         else:
@@ -477,13 +563,17 @@ class InferenceEngine:
                                  start, suffix_len, rng):
                 cache, last = _extend_impl(params, cache, slot, bt_row,
                                            suffix, start, suffix_len)
-                return cache, _pick(last, rng)
+                return _pin(cache), _pick(last, rng)
 
             self._extend = jax.jit(_extend_and_pick, donate_argnums=(1,))
 
         def _prefill_and_pick(params, prompt, lens, rng):
+            # the B=1 row cache is pinned head-sharded too: the insert
+            # program's row input then always arrives in ONE layout,
+            # whether it came from a fresh prefill, the prefix cache, or
+            # prewarm's zero row
             cache, last = self._prefill(params, prompt, lens)
-            return cache, _pick(last, rng)
+            return _pin(cache), _pick(last, rng)
 
         self._prefill_and_pick = jax.jit(_prefill_and_pick)
         self._greedy = temperature == 0.0
@@ -494,9 +584,19 @@ class InferenceEngine:
             self._rng, (self.decode_ahead,) + self._rng.shape)
 
         # --- mutable engine state ---
+        # cache zeros materialize DIRECTLY in their final layout: under tp
+        # the shape probe runs first, the head-axis sharding tree is built
+        # from it, and allocation jits with out_shardings — a pool bigger
+        # than one chip's memory never transits a single device
+        _shapes = (
+            paged_cache_shapes(model, params, slots, max_len, kv_page_size,
+                               kv_pages) if kv_page_size
+            else cache_shapes(model, params, slots, max_len))
+        self._cache_shardings = (
+            None if self._mesh is None else mesh_shardings(
+                self._mesh, make_param_specs(_shapes, self._kv_rule)))
         if kv_page_size:
-            self.cache = init_paged_cache(model, params, slots, max_len,
-                                          kv_page_size, kv_pages)
+            self.cache = _zeros_like_shapes(_shapes, self._cache_shardings)
             self._pool = KVPagePool(kv_pages, kv_page_size)
             self._page_bytes = pool_page_bytes(self.cache)
             self._radix = (
@@ -509,7 +609,7 @@ class InferenceEngine:
             self._slot_alloc: list[list | None] = [None] * slots
             self._deferred_free: list[list] = []
         else:
-            self.cache = init_cache(model, params, slots, max_len)
+            self.cache = _zeros_like_shapes(_shapes, self._cache_shardings)
             self._pool = None
             self._radix = None
             self._slot_alloc = [None] * slots
@@ -536,6 +636,43 @@ class InferenceEngine:
         self._last_progress_t: float | None = None  # watchdog anchor
         self._draining = False  # drain(): serve what's accepted, admit no more
         self._closed = False
+        # per-chip footprint stamped up front: even a run that serves zero
+        # requests reports what the config costs one chip (ISSUE 10)
+        self._stamp_memory()
+
+    def _stamp_memory(self) -> None:
+        """(Re-)stamp the per-chip memory figures into ``self.stats`` —
+        at construction, and again at every drain/close emit point so a
+        caller that swapped in a fresh ServingStats still reports them."""
+        self.stats.memory(
+            tp=self.tp, kv_bytes_per_chip=self.kv_bytes_per_chip(),
+            weight_bytes_per_chip=self.weight_bytes_per_chip())
+
+    def _dev(self, x):
+        """Host upload for per-window device inputs.  Single-chip: a plain
+        uncommitted transfer (byte-identical to the pre-tp engine).  Under
+        tp: COMMITTED replicated-on-mesh, so the first dispatch (prewarm)
+        and every serving dispatch present jit the SAME input shardings —
+        one program per site, never a layout-keyed recompile."""
+        x = jnp.asarray(x)
+        return x if self._rep is None else jax.device_put(x, self._rep)
+
+    @property
+    def _chip0(self):
+        """The accounting chip: per-chip byte figures are measured on one
+        fixed mesh device (they are equal across the mesh by symmetry)."""
+        return None if self._mesh is None else self._mesh.devices.flat[0]
+
+    def kv_bytes_per_chip(self) -> int:
+        """KV-cache bytes resident on ONE chip — the whole cache at tp=1;
+        the head-axis shard plus the replicated block tables/cursors under
+        tp (1/tp of the slab bytes, the ISSUE 10 memory claim)."""
+        return per_chip_bytes(self.cache, self._chip0)
+
+    def weight_bytes_per_chip(self) -> int:
+        """Decode-weight bytes resident on ONE chip (Megatron column/row
+        shards under tp; replicated leaves count whole)."""
+        return per_chip_bytes(self.params, self._chip0)
 
     @staticmethod
     def _insert_impl(cache, row_cache, slot):
@@ -829,7 +966,7 @@ class InferenceEngine:
             bt_row[j] = node.page
         for j, page in enumerate(private):
             bt_row[m_blocks + j] = page
-        bt_dev = jnp.asarray(bt_row)
+        bt_dev = self._dev(bt_row)
         if m_blocks:
             suffix = req.tokens[m_tok:]
             sb = self.scheduler.bucket_for(suffix.size)
@@ -1097,13 +1234,13 @@ class InferenceEngine:
                             chunk[slot, 1:1 + d.size] = d
                             dls[slot] = d.size
                     with self._compile.site("slot_draft"):
-                        chunk_dev = jnp.asarray(chunk)
-                        dls_dev = jnp.asarray(dls)
+                        chunk_dev = self._dev(chunk)
+                        dls_dev = self._dev(dls)
                     t_d1 = self.clock()
                 elif self._tok_dev is None:
-                    self._tok_dev = jnp.asarray(self._slot_tok)
+                    self._tok_dev = self._dev(self._slot_tok)
                 if self._active_dev is None:
-                    self._active_dev = jnp.asarray(
+                    self._active_dev = self._dev(
                         np.array([r is not None for r in self._slot_req]))
                 t_disp = self.clock()
                 if spec:
@@ -1247,7 +1384,7 @@ class InferenceEngine:
         #    the next admission starts from a clean row
         if reset_mask.any():
             with self._compile.site("slot_reset"):
-                self.cache = self._reset(self.cache, jnp.asarray(reset_mask))
+                self.cache = self._reset(self.cache, self._dev(reset_mask))
         # deferred page frees apply only now, AFTER the reset dispatch is
         # enqueued: single-stream device execution guarantees every program
         # still reading a retired slot's block table runs before any later
@@ -1285,7 +1422,7 @@ class InferenceEngine:
             self._fail(req, exc, now)
             mask[slot] = True
         if mask.any():
-            self.cache = self._reset(self.cache, jnp.asarray(mask))
+            self.cache = self._reset(self.cache, self._dev(mask))
         self._flush_freed_pages()
         self._active_dev = None
         self._last_progress_t = None
@@ -1311,6 +1448,7 @@ class InferenceEngine:
                 self.stats.prefix_oversized(self._prefix.oversized)
             self.stats.set_compile(CompileTracker.delta(
                 self._compile.snapshot(), self._compile0))
+            self._stamp_memory()
             if self.writer is not None:
                 self.stats.emit(self.writer)
         return self.completed
@@ -1351,7 +1489,7 @@ class InferenceEngine:
             self._retire(slot, "cancelled", now)
             mask[slot] = True
         if mask.any():
-            self.cache = self._reset(self.cache, jnp.asarray(mask))
+            self.cache = self._reset(self.cache, self._dev(mask))
         self._flush_freed_pages()
         for req, _prefilled in self._pending:  # overlap-prefilled, unlanded
             req.engine_fault = True
@@ -1385,6 +1523,7 @@ class InferenceEngine:
                                    self._page_size, self._page_bytes)
         self.stats.set_compile(CompileTracker.delta(
             self._compile.snapshot(), self._compile0))
+        self._stamp_memory()
         if self.writer is not None:
             self.stats.emit(self.writer)
         self._closed = True
@@ -1421,6 +1560,12 @@ class InferenceEngine:
                 f"pending={len(self._pending)}, queued={len(self.scheduler)})"
                 " — drain it first (stop submitting, pump step() until "
                 "has_work is False)")
+        if self._mesh is not None:
+            # accepts a full host/single-chip tree and re-shards it
+            # wholesale onto THIS engine's mesh (the router's hot-swap
+            # hands every replica the same unsharded checkpoint tree);
+            # an already-correctly-sharded tree is a no-op placement
+            params = jax.device_put(params, self._param_shardings)
         self.params = params
         if self._prefix is not None:
             self._prefix.clear()
@@ -1486,9 +1631,15 @@ class InferenceEngine:
             self.params)
         row_cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype), row_shapes)
+        if self._mesh is not None:
+            # match the layout a REAL prefill's pinned output arrives in,
+            # so prewarm compiles the same insert program serving reuses
+            row_cache = jax.device_put(row_cache, mesh_shardings(
+                self._mesh, make_param_specs(row_shapes, self._kv_rule)))
         slot0 = jnp.asarray(0, jnp.int32)
         if self._pool is not None:
-            bt_row = jnp.zeros((self.max_len // self._page_size,), jnp.int32)
+            bt_row = self._dev(np.zeros((self.max_len // self._page_size,),
+                                        np.int32))
             with self._compile.site("slot_insert"):
                 self.cache = self._insert(self.cache, row_cache, bt_row,
                                           slot0)
@@ -1502,20 +1653,21 @@ class InferenceEngine:
         else:
             with self._compile.site("slot_insert"):
                 self.cache = self._insert(self.cache, row_cache, slot0)
-        inactive = jnp.zeros((self.slots,), bool)
+        inactive = self._dev(np.zeros((self.slots,), bool))
         if self._verify is not None:
             k = self.draft_len + 1
             with self._compile.site(f"verify_window[k{k}]"):
                 self.cache, _, _, _ = self._verify(
                     self.params, self.cache,
-                    jnp.full((self.slots, k), self.pad_id, jnp.int32),
-                    jnp.zeros((self.slots,), jnp.int32), inactive)
+                    self._dev(np.full((self.slots, k), self.pad_id,
+                                      np.int32)),
+                    self._dev(np.zeros((self.slots,), np.int32)), inactive)
         else:
             k = self.decode_ahead
             with self._compile.site(f"decode_window[k{k}]"):
                 self.cache, _, _ = self._window(
                     self.params, self.cache,
-                    jnp.zeros((self.slots,), jnp.int32), inactive,
+                    self._dev(np.zeros((self.slots,), np.int32)), inactive,
                     jnp.broadcast_to(rng, (k,) + rng.shape))
         with self._compile.site("slot_reset"):
             self.cache = self._reset(self.cache, inactive)

@@ -41,6 +41,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from ..core.generate import _zeros_like_shapes
+
 TRASH_PAGE = 0
 
 
@@ -110,7 +112,7 @@ class KVPagePool:
 
 
 def init_paged_cache(model, params, slots: int, max_len: int,
-                     page_size: int, n_pages: int):
+                     page_size: int, n_pages: int, shardings=None):
     """A zeroed paged decode cache for ``model``: per-layer page pools
     sized ``n_pages`` plus per-slot block tables and cursors, derived from
     the DENSE decode layout via ``jax.eval_shape`` (no forward runs), so
@@ -120,7 +122,22 @@ def init_paged_cache(model, params, slots: int, max_len: int,
     ``model`` may be the dense model or its paged clone; the dense layout
     is probed either way.  Every block table starts all-TRASH (page 0) and
     every cursor at 0 — the state ``paged_reset`` restores per slot.
+
+    ``shardings`` (a pytree of shardings matching the returned cache
+    structure) allocates each pool leaf directly in its sharded layout, so
+    a pool bigger than one chip never materializes on a single device.
     """
+    return _zeros_like_shapes(
+        paged_cache_shapes(model, params, slots, max_len, page_size,
+                           n_pages), shardings)
+
+
+def paged_cache_shapes(model, params, slots: int, max_len: int,
+                       page_size: int, n_pages: int):
+    """ShapeDtypeStruct tree of the paged cache :func:`init_paged_cache`
+    allocates — exposed (like ``core.generate.cache_shapes``) so the
+    tensor-parallel engine can derive a congruent sharding tree before
+    any pool memory exists."""
     if max_len % page_size:
         raise ValueError(
             f"max_len ({max_len}) must be a multiple of page_size "
@@ -136,24 +153,25 @@ def init_paged_cache(model, params, slots: int, max_len: int,
         params,
     )
     n_row = max_len // page_size
-    cache = {}
+    struct = jax.ShapeDtypeStruct
+    paged_shapes = {}
     for name, entry in shapes.items():
         k = entry["k"]  # (slots, max_len, hkv, d)
         hkv, d = k.shape[2], k.shape[3]
         paged = {
-            "pages_k": jnp.zeros((n_pages, page_size, hkv, d), k.dtype),
-            "pages_v": jnp.zeros((n_pages, page_size, hkv, d),
-                                 entry["v"].dtype),
-            "block_table": jnp.zeros((slots, n_row), jnp.int32),
-            "index": jnp.zeros((slots,), jnp.int32),
+            "pages_k": struct((n_pages, page_size, hkv, d), k.dtype),
+            "pages_v": struct((n_pages, page_size, hkv, d),
+                              entry["v"].dtype),
+            "block_table": struct((slots, n_row), jnp.int32),
+            "index": struct((slots,), jnp.int32),
         }
         if "k_scale" in entry:
-            paged["pages_k_scale"] = jnp.zeros(
+            paged["pages_k_scale"] = struct(
                 (n_pages, page_size, hkv), entry["k_scale"].dtype)
-            paged["pages_v_scale"] = jnp.zeros(
+            paged["pages_v_scale"] = struct(
                 (n_pages, page_size, hkv), entry["v_scale"].dtype)
-        cache[name] = paged
-    return cache
+        paged_shapes[name] = paged
+    return paged_shapes
 
 
 def pool_page_bytes(cache) -> int:

@@ -32,6 +32,7 @@ their host loops from interleaving on a single lane.
 
 from __future__ import annotations
 
+import inspect
 import time
 from typing import Callable
 
@@ -45,6 +46,12 @@ class Replica:
 
     ``make_engine(trace_tid)`` must return a fresh
     :class:`~.engine.InferenceEngine`; it is called at every (re)spawn.
+    A factory that takes a SECOND positional parameter is called as
+    ``make_engine(trace_tid, replica_index)`` — the tensor-parallel seam:
+    replica ``i`` builds its engine on its own disjoint device group
+    (``tp_devices=tp_device_groups(n, tp)[i]``), so failover and hot-swap
+    compose with tp without sharing a chip between failure domains.  The
+    arity is inspected once at construction, so respawns never re-probe.
     The factory should NOT wire a per-engine ``writer=`` — the router
     emits ONE merged cluster record (``ServingStats.merge``) instead of N
     interleaved per-engine records.
@@ -53,6 +60,11 @@ class Replica:
     def __init__(self, index: int, make_engine: Callable, tracer=None):
         self.index = int(index)
         self._make_engine = make_engine
+        try:
+            n_params = len(inspect.signature(make_engine).parameters)
+        except (TypeError, ValueError):  # builtins/partials w/o signature
+            n_params = 1
+        self._factory_wants_index = n_params >= 2
         self._tracer = tracer
         # the replica's own timeline lane, stable across respawns — every
         # engine this replica ever runs logs its host loop here
@@ -83,7 +95,9 @@ class Replica:
                 f"replica {self.index} already has a live engine — close it "
                 "(router failover does) before respawning")
         t0 = time.perf_counter()
-        self.engine = self._make_engine(self.tid)
+        self.engine = (self._make_engine(self.tid, self.index)
+                       if self._factory_wants_index
+                       else self._make_engine(self.tid))
         self.spawn_s = time.perf_counter() - t0
         self.spawn_history.append(self.spawn_s)
         self.spawns += 1

@@ -155,6 +155,13 @@ class Router:
     ``make_engine(trace_tid)`` is the replica factory (serving/replica.py
     — wire ``compile_cache_dir=`` there for warm respawns, share this
     router's ``clock`` for deadline coherence, leave ``writer=`` unset).
+    A two-parameter factory ``make_engine(trace_tid, replica_index)``
+    composes replicas x tensor parallelism: give replica ``i`` the
+    ``i``-th disjoint device group from ``parallel.tensor_parallel.
+    tp_device_groups(n_replicas, tp)`` as its ``tp_devices=`` — failover,
+    probes, and hot-swap then work unchanged (the engine re-shards a
+    swapped host tree onto its own mesh; ``ServingStats.merge`` rolls
+    per-chip bytes up as max-per-chip + cluster totals).
     ``probe=`` optionally layers a policy health check (``probe(replica)
     -> bool``) over the structural one; a False verdict fails the replica
     exactly like an engine-wide fault.  ``max_drain_steps`` bounds how

@@ -107,6 +107,12 @@ class ServingStats:
         # program family: a CompileTracker snapshot DELTA from engine
         # construction to stats emission (utils/tracing.py)
         self._compile: dict | None = None
+        # --- tensor-parallel per-chip footprint (ISSUE 10) --- stamped by
+        # the engine (memory()); tp=1 with whole-tree bytes on single-chip
+        # engines, so the schema never branches on the mesh
+        self._tp = 1
+        self._kv_bytes_per_chip: int | None = None
+        self._weight_bytes_per_chip: int | None = None
 
     def tick(self, occupied: int, dt: float, decoded: bool = False) -> None:
         self._occ_time += occupied * dt
@@ -166,6 +172,16 @@ class ServingStats:
             self._radix_hit_tokens += int(tokens)
         else:
             self._radix_misses += 1
+
+    def memory(self, tp: int, kv_bytes_per_chip: int,
+               weight_bytes_per_chip: int) -> None:
+        """Stamp the engine's tensor-parallel degree and per-chip memory
+        footprint (parallel/tensor_parallel.per_chip_bytes over the cache
+        and the decode weights).  Re-stamped at every emit point, so a
+        stats object swapped in mid-run still reports them."""
+        self._tp = int(tp)
+        self._kv_bytes_per_chip = int(kv_bytes_per_chip)
+        self._weight_bytes_per_chip = int(weight_bytes_per_chip)
 
     def set_compile(self, delta: dict) -> None:
         """Record the engine's compile accounting — a
@@ -252,6 +268,11 @@ class ServingStats:
             "kv_pages_peak": self._kv_pages_peak,
             "kv_bytes_live": self._kv_pages_live * self._kv_page_bytes,
             "kv_bytes_peak": self._kv_pages_peak * self._kv_page_bytes,
+            # tensor-parallel per-chip footprint (tp=1 / None until the
+            # engine stamps it — null, never NaN)
+            "tp": self._tp,
+            "kv_bytes_per_chip": self._kv_bytes_per_chip,
+            "weight_bytes_per_chip": self._weight_bytes_per_chip,
             # radix prefix sharing (partial-prefix prefill skips)
             "radix_hits": self._radix_hits,
             "radix_misses": self._radix_misses,
@@ -319,6 +340,13 @@ class ServingStats:
         r_hits = sum(rec._radix_hits for rec in records)
         r_miss = sum(rec._radix_misses for rec in records)
         compiled = [rec._compile for rec in records if rec._compile is not None]
+        # replicas hold DISJOINT TP groups (parallel/tensor_parallel.
+        # tp_device_groups), so the cluster's per-chip figure is the worst
+        # chip anywhere (max), the cluster total sums per_chip * tp per
+        # engine, and `tp` reports the common degree or None when mixed
+        tps = {rec._tp for rec in records}
+        stamped = [rec for rec in records
+                   if rec._kv_bytes_per_chip is not None]
         out = {
             "n_engines": len(records),
             "slots": slots,
@@ -370,6 +398,19 @@ class ServingStats:
             "radix_hit_tokens": sum(rec._radix_hit_tokens for rec in records),
             "radix_hit_rate": (round(r_hits / (r_hits + r_miss), 4)
                                if (r_hits + r_miss) > 0 else None),
+            "tp": tps.pop() if len(tps) == 1 else None,
+            "kv_bytes_per_chip": (
+                max(rec._kv_bytes_per_chip for rec in stamped)
+                if stamped else None),
+            "weight_bytes_per_chip": (
+                max(rec._weight_bytes_per_chip for rec in stamped)
+                if stamped else None),
+            "kv_bytes_cluster": (
+                sum(rec._kv_bytes_per_chip * rec._tp for rec in stamped)
+                if stamped else None),
+            "weight_bytes_cluster": (
+                sum(rec._weight_bytes_per_chip * rec._tp for rec in stamped)
+                if stamped else None),
             "n_compiled_programs": (
                 sum(c["n_compiled_programs"] for c in compiled)
                 if compiled else None),

@@ -110,6 +110,43 @@ def attention_flops(
     return f
 
 
+def decode_step_flops(
+    batch: int, kv_span: int, dim: int, heads: int, head_dim: int, *,
+    heads_kv: int | None = None, depth: int = 1, vocab: int = 0,
+) -> float:
+    """Analytic matmul FLOPs of ONE incremental decode step (S=1 per row),
+    GQA-aware — the MFU numerator for serving decode benches.
+
+    Per layer: q projection ``2*B*dim*(H*D)``, kv projection
+    ``2*B*dim*(2*Hkv*D)`` — the GROUPED width: a ``heads_kv < heads``
+    model computes and caches only ``Hkv`` key/value heads, and charging
+    the full ``H`` here is exactly the over-report that made earlier
+    bench MFU flatter GQA configs — out projection ``2*B*(H*D)*dim``, and
+    the 4x MLP pair ``16*B*dim^2``.  Cache attention (QK^T + PV over the
+    ``kv_span`` attended positions) is charged at the grouped cache width
+    ``4*B*kv_span*Hkv*D`` — deliberately the CONSERVATIVE convention:
+    each of the H query heads mathematically scores every cached
+    position (an execution count of ``4*B*kv_span*H*D``), but the
+    grouped figure is what the bandwidth-bound step streams from HBM and
+    keeps reported MFU a lower bound instead of crediting GQA with
+    shared-K work it never re-reads.  ``heads_kv=None`` (or ``== heads``)
+    is MHA and reproduces the ungrouped count exactly.  Forward only —
+    decode has no backward.  ``vocab > 0`` adds the final logits matmul
+    ``2*B*dim*vocab`` (once, not per layer).
+    """
+    hkv = heads if heads_kv is None else heads_kv
+    if not 0 < hkv <= heads:
+        raise ValueError(f"heads_kv must be in 1..heads, got {hkv}/{heads}")
+    per_layer = (
+        2.0 * batch * dim * heads * head_dim          # q projection
+        + 2.0 * batch * dim * 2 * hkv * head_dim      # k+v projection
+        + 4.0 * batch * kv_span * hkv * head_dim      # QK^T + PV (grouped)
+        + 2.0 * batch * heads * head_dim * dim        # out projection
+        + 16.0 * batch * dim * dim                    # MLP (4x, two mats)
+    )
+    return per_layer * depth + 2.0 * batch * dim * vocab
+
+
 def compiled_flops(jitted_fn, *args) -> float | None:
     """Per-device FLOPs of one call of a jitted function, from XLA's cost
     analysis of the compiled (post-SPMD-partitioning) module.

@@ -116,6 +116,16 @@ def time_config(trainer, batch: int, prompt_len: int, max_new: int,
                                     hkv if hkv is not None else HEADS)
     ideal_ms = (pbytes + cbytes) / hbm_bps * 1e3
     ms_per_step = net / max_new * 1e3
+    # GQA-aware analytic step FLOPs (utils/flops.decode_step_flops: kv
+    # projection + cache attention at the GROUPED width) over the full
+    # attended span — an upper bound per step (the cache fills as the
+    # episode runs), consistent with roofline_bytes' span convention
+    from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+        decode_step_flops, mfu)
+    step_flops = decode_step_flops(
+        batch, kv_span or max_len, DIM, HEADS, DIM // HEADS,
+        heads_kv=hkv, depth=DEPTH, vocab=VOCAB)
+    step_mfu = mfu(step_flops / (net / max_new))
     row = {
         "config": label, "batch": batch, "prompt_len": prompt_len,
         "max_new": max_new, "max_len": max_len,
@@ -128,6 +138,8 @@ def time_config(trainer, batch: int, prompt_len: int, max_new: int,
         "cache_mb_per_step": round(cbytes / 1e6, 2),
         "ideal_ms_per_step": round(ideal_ms, 4),
         "roofline_x": round(ms_per_step / ideal_ms, 2),
+        "model_gflops_per_step": round(step_flops / 1e9, 4),
+        "mfu": round(step_mfu, 4) if step_mfu is not None else None,
     }
     print(json.dumps(row), flush=True)
     return row

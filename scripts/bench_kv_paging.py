@@ -28,6 +28,13 @@ change a single token).  Peak CONCURRENT sessions is sampled after every
 host step; the headline ``concurrency_ratio`` is paged peak / dense peak
 at equal bytes, and the acceptance gate is >= 2x.
 
+A third leg (ISSUE 10 satellite) re-runs the paged stream with a GQA
+model at the same dim (``heads_kv = heads // 4``): pages are
+token-granular, so pages-per-request MATCHES the MHA leg while each
+page stores ``heads_kv`` heads — peak live KV bytes drop by ~H/Hkv,
+reported as ``gqa.mha_over_gqa_bytes`` and gated at >= 0.9 * H/Hkv,
+with token parity pinned against a dense GQA engine.
+
 Run in a subprocess by bench.py or directly::
 
     JAX_PLATFORMS=cpu python scripts/bench_kv_paging.py
@@ -72,12 +79,13 @@ N_REQUESTS = 12 if QUICK else 32
 KV_PAGES = SLOTS_DENSE * MAX_LEN // PAGE_SIZE + 1  # +1: reserved trash page
 
 
-def build_engine(**kw):
+def build_engine(heads_kv=None, **kw):
     from distributed_tensorflow_ibm_mnist_tpu.models.causal_lm import CausalLM
     from distributed_tensorflow_ibm_mnist_tpu.serving import InferenceEngine
 
+    mk = {} if heads_kv is None else {"heads_kv": heads_kv}
     model = CausalLM(num_classes=VOCAB, dim=DIM, depth=DEPTH, heads=HEADS,
-                     dtype=jnp.float32)
+                     dtype=jnp.float32, **mk)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     return InferenceEngine(model, params, max_len=MAX_LEN,
@@ -126,7 +134,25 @@ def main() -> int:
     paged_bytes = kv_bytes(paged_eng)
     paged_out, paged_peak, paged_wall, paged_stats = serve(paged_eng, prompts)
 
+    # GQA leg (ISSUE 10 satellite): the same dim with heads_kv = heads//4
+    # — pages are token-granular, so a request PINS the same page COUNT as
+    # the MHA leg while every page holds Hkv instead of H heads: bytes
+    # drop by ~heads/heads_kv at equal live tokens.  Token parity is
+    # checked against a dense GQA engine (paging stays invisible in the
+    # tokens); the MHA comparison is bytes-only (different weights).
+    HEADS_KV = max(1, HEADS // 4)
+    gq_dense_out, _, _, _ = serve(
+        build_engine(heads_kv=HEADS_KV, slots=SLOTS_DENSE), prompts)
+    gq_eng = build_engine(heads_kv=HEADS_KV, slots=SLOTS_PAGED,
+                          kv_page_size=PAGE_SIZE, kv_pages=KV_PAGES)
+    gq_bytes = kv_bytes(gq_eng)
+    gq_out, gq_peak, gq_wall, gq_stats = serve(gq_eng, prompts)
+
     outputs_match = paged_out == dense_out
+    gq_match = gq_out == gq_dense_out
+    # bytes per live token, MHA paged vs GQA paged — the ~H/Hkv claim
+    gq_bytes_ratio = (paged_stats["kv_bytes_peak"]
+                      / max(gq_stats["kv_bytes_peak"], 1))
     ratio = paged_peak / dense_peak if dense_peak else 0.0
     useful = N_REQUESTS * MAX_NEW
     record = {
@@ -156,10 +182,29 @@ def main() -> int:
             "radix_hits": paged_stats["radix_hits"],
             "radix_hit_tokens": paged_stats["radix_hit_tokens"],
         },
+        "gqa": {
+            "heads_kv": HEADS_KV,
+            "kv_bytes": gq_bytes,
+            "kv_bytes_live": gq_stats["kv_bytes_live"],
+            "kv_bytes_peak": gq_stats["kv_bytes_peak"],
+            "kv_pages_peak": gq_stats["kv_pages_peak"],
+            "pages_per_request": round(
+                gq_stats["kv_pages_total"] / N_REQUESTS, 2),
+            "mha_pages_per_request": round(
+                paged_stats["kv_pages_total"] / N_REQUESTS, 2),
+            "peak_concurrency": gq_peak,
+            "tok_per_s": round(useful / gq_wall, 1),
+            # MHA-paged peak bytes over GQA-paged peak bytes at the same
+            # stream: pages are token-granular so the page COUNT matches
+            # and the whole ~H/Hkv saving shows up here
+            "mha_over_gqa_bytes": round(gq_bytes_ratio, 3),
+            "outputs_match_dense_gqa": gq_match,
+        },
         "bytes_ratio": round(paged_bytes / dense_bytes, 4),
         "concurrency_ratio": round(ratio, 2),
         "outputs_match": outputs_match,
-        "ok": bool(outputs_match and ratio >= 2.0),
+        "ok": bool(outputs_match and ratio >= 2.0 and gq_match
+                   and gq_bytes_ratio >= 0.9 * HEADS / HEADS_KV),
     }
     print(json.dumps(record))
     return 0 if record["ok"] else 4

@@ -341,6 +341,12 @@ CENSUS_BUDGET = {
     "spec_cold": 7,         # prefill[b16](+pick) + verify_window[k4] +
     #                         insert + reset + 2 unattributed helper jits
     "spec_repeat": 0,       # speculation adds its programs once too
+    "tp_cold": 6,           # the dense serve family under GSPMD — prefill
+    #                         (+pick), window, insert, reset + 2
+    #                         unattributed helper jits; the sharded
+    #                         cache-alloc/param-upload programs compile at
+    #                         engine CONSTRUCTION, before this leg's delta
+    "tp_repeat": 0,         # tp changes program CONTENTS, never counts
 }
 
 # Per-site pins for the speculative leg (ISSUE 9): the verify window is
@@ -370,6 +376,11 @@ def run_compile_census(slots: int) -> dict:
        decode window; ``slot_draft`` must compile NOTHING — per-site pins
        in ``SPEC_SITE_BUDGET``);
     8. spec_repeat: zero.
+    9. tp_cold (ISSUE 10, >= 2 devices): the same dense family under a
+       2-chip tp mesh — ONE program per (site, shape-key); GSPMD changes
+       program contents, never counts, and a site compiling twice means
+       the jit cache key is flapping on input shardings;
+    10. tp_repeat: zero again.
     """
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
@@ -429,9 +440,31 @@ def run_compile_census(slots: int) -> dict:
                                 max_queue=8))
     legs["spec_cold"] = serve_one(seng, [rand_prompt(8)])
     legs["spec_repeat"] = serve_one(seng, [rand_prompt(10)])
+    # the tensor-parallel program family (ISSUE 10): the SAME engine
+    # sharded over a 2-chip tp mesh must stay ONE program per (site,
+    # shape-key) — GSPMD partitioning changes what each program contains,
+    # never how many there are.  A tp_cold count above the dense cold set
+    # (+ the sharded-upload helpers) or ANY tp_repeat compile means the
+    # mesh path leaks programs per request (e.g. committed/uncommitted
+    # input sharding flapping the jit cache key).
+    teng = None
+    if len(jax.devices()) >= 2:
+        teng = InferenceEngine(
+            model, params, slots=slots, max_len=max_len, tp=2,
+            scheduler=FIFOScheduler(max_len=max_len, buckets=(16, 32),
+                                    max_queue=8))
+        legs["tp_cold"] = serve_one(teng, [rand_prompt(8)])
+        legs["tp_repeat"] = serve_one(teng, [rand_prompt(10)])
     over = {name: leg["n_new_programs"] - CENSUS_BUDGET[name]
             for name, leg in legs.items()
             if leg["n_new_programs"] > CENSUS_BUDGET[name]}
+    if teng is not None:
+        # one-program-per-site within the tp cold set: a site compiling
+        # twice under tp (same shape-key) is exactly the sharding-flap
+        # regression the leg exists to catch
+        for site, n in legs["tp_cold"]["by_site"].items():
+            if site != "unattributed" and n > 1:
+                over[f"tp_cold:{site}"] = n - 1
     for site, budget in SPEC_SITE_BUDGET.items():
         n = legs["spec_cold"]["by_site"].get(site, 0)
         if n > budget:
@@ -452,7 +485,9 @@ def run_compile_census(slots: int) -> dict:
             legs["bucket16_repeat"]["n_new_programs"] == 0
             and legs["bucket32_repeat"]["n_new_programs"] == 0
             and legs["paged_repeat"]["n_new_programs"] == 0
-            and legs["spec_repeat"]["n_new_programs"] == 0),
+            and legs["spec_repeat"]["n_new_programs"] == 0
+            and legs.get("tp_repeat", {"n_new_programs": 0})[
+                "n_new_programs"] == 0),
         "new_bucket_compiles": legs["bucket32_new"]["n_new_programs"] > 0,
     }
 
@@ -676,6 +711,15 @@ def main() -> None:
         return
     if QUICK:
         args.requests = min(args.requests, 10)
+
+    # tensor-parallel census legs (ISSUE 10) need a multi-chip platform;
+    # arm it before ANY jax array exists — single-device legs are
+    # unaffected (unsharded jits run on device 0 regardless)
+    from distributed_tensorflow_ibm_mnist_tpu.utils.hostmesh import (
+        ensure_virtual_cpu_devices,
+    )
+
+    ensure_virtual_cpu_devices(8)
 
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
 

@@ -1,0 +1,157 @@
+"""Training-side compile census: path-qualified program-count regression gate.
+
+The serving engine's program family has been census-gated since ISSUE 7
+(scripts/bench_serving.py CENSUS_BUDGET); this closes the ROADMAP 5a
+remainder by giving ``Trainer.fit()`` the same discipline.  The Trainer
+labels its compile sites with the parallelism PATH the run took
+(``train_epoch[dp4_fsdp]``, ``eval[dp2_pp2]``, ``h2d[dp1_stream]`` — the
+label is built once at Trainer init from dp/fsdp/tp/sp/pp/
+sharded_update/stream), and ``fit()``'s summary now carries the by-site
+delta as ``compile_by_site``.  This script runs one tiny fit per path and
+pins each path's per-site program counts in ``CENSUS_BUDGET``:
+
+* a site exceeding its pinned count means the path grew a program — a
+  compile-storm/cache-churn regression even when every test passes;
+* the budgets are the MEASURED counts of the current trainer, pinned
+  exact, so one extra program anywhere fails the gate (exit status 3).
+
+Paths covered: plain dp1, dp1 stream-input (the h2d site), dp4, dp4+fsdp
+(ZeRO-3), dp4+sharded_update (ZeRO-1), and dp2 x pp2 (GPipe) — every
+parallelism family that changes which programs fit() compiles.
+
+Designed to run in a SUBPROCESS (bench.py spawns it with
+``JAX_PLATFORMS=cpu``); self-arms 8 virtual CPU devices when run
+directly:
+
+    python scripts/bench_train_census.py
+
+Prints ONE JSON line (metric "train_census") and exits 3 on any breach.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Pinned per-path, per-site budgets: the measured program counts of the
+# current trainer (site labels come from Trainer._path_label).  The scan
+# epoch is ONE program per path; eval is one; the stream path compiles
+# the chunk runner + the ragged-tail step + their device_put layouts.
+# Exceeding any count is a leak; a MISSING measured site also fails (the
+# attribution itself regressed).
+CENSUS_BUDGET = {
+    "dp1": {"train_epoch[dp1]": 1, "eval[dp1]": 1},
+    # stream mode compiles the chunk runner, the ragged-tail per-step
+    # runner, and their two metric-stack helpers inside the epoch; the
+    # h2d site itself must compile NOTHING (device_put is a transfer,
+    # and a program appearing there means the input path grew a jit)
+    "dp1_stream": {"train_epoch[dp1_stream]": 4, "h2d[dp1_stream]": 0,
+                   "eval[dp1_stream]": 1},
+    "dp4": {"train_epoch[dp4]": 1, "eval[dp4]": 1},
+    "dp4_fsdp": {"train_epoch[dp4_fsdp]": 1, "eval[dp4_fsdp]": 1},
+    "dp4_su": {"train_epoch[dp4_su]": 1, "eval[dp4_su]": 1},
+    "dp2_pp2": {"train_epoch[dp2_pp2]": 1, "eval[dp2_pp2]": 1},
+}
+
+
+def _mlp_cfg(**kw):
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    base = dict(
+        model="mlp", model_kwargs={"hidden": (32,)}, dataset="mnist",
+        synthetic=True, n_train=256, n_test=64, batch_size=64, epochs=1,
+        quiet=True, eval_batch_size=64,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _lm_pp_cfg():
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_ibm_mnist_tpu.utils.config import RunConfig
+
+    return RunConfig(
+        name="census_pp", model="causal_lm", dp=2, pp=2,
+        model_kwargs={"dim": 32, "depth": 2, "heads": 2,
+                      "dtype": jnp.float32},
+        dataset="retrieval", dataset_kwargs={"vocab": 16, "seq_len": 32},
+        n_train=128, n_test=32, batch_size=32, epochs=1, quiet=True,
+        eval_batch_size=32,
+    )
+
+
+def run_path(cfg) -> dict:
+    """One fit; returns {label, by_site (n per site), n_programs}."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.trainer import Trainer
+
+    t = Trainer(cfg)
+    try:
+        summary = t.fit()
+    finally:
+        t.close()
+    return {
+        "label": t._path_label,
+        "by_site": {k: v["n"] for k, v in summary["compile_by_site"].items()},
+        "n_programs": summary["n_compiled_programs"],
+    }
+
+
+def main() -> None:
+    from distributed_tensorflow_ibm_mnist_tpu.utils.hostmesh import (
+        ensure_virtual_cpu_devices,
+    )
+
+    n = ensure_virtual_cpu_devices(8)
+    if n < 8:
+        print(json.dumps({"metric": "train_census", "skipped": True,
+                          "reason": f"only {n} devices"}), flush=True)
+        return
+
+    configs = {
+        "dp1": _mlp_cfg(),
+        "dp1_stream": _mlp_cfg(input_mode="stream", stream_chunk=2),
+        "dp4": _mlp_cfg(dp=4),
+        "dp4_fsdp": _mlp_cfg(dp=4, fsdp=True),
+        "dp4_su": _mlp_cfg(dp=4, sharded_update=True),
+        "dp2_pp2": _lm_pp_cfg(),
+    }
+    paths: dict[str, dict] = {}
+    over: dict[str, int] = {}
+    for name, cfg in configs.items():
+        res = run_path(cfg)
+        paths[name] = res
+        budget = CENSUS_BUDGET[name]
+        if res["label"] != name:
+            over[f"{name}:label"] = res["label"]  # attribution regressed
+            continue
+        for site, pinned in budget.items():
+            got = res["by_site"].get(site, 0)
+            if got > pinned:
+                over[f"{name}:{site}"] = got - pinned
+        for site, got in res["by_site"].items():
+            # a site outside the pinned set (other than unattributed
+            # helper jits) means a NEW program family member appeared
+            if site not in budget and site != "unattributed" and got > 0:
+                over[f"{name}:{site}"] = got
+
+    result = {
+        "metric": "train_census",
+        "paths": paths,
+        "budget": CENSUS_BUDGET,
+        "over_budget": over,
+        "census_ok": not over,
+    }
+    print(json.dumps(result), flush=True)
+    if over:
+        print(f"train compile census over budget: {over}", file=sys.stderr)
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,38 @@ def test_mfu_fraction(monkeypatch):
     assert abs(mfu(50e12) - 0.5) < 1e-9
 
 
+def test_decode_step_flops_gqa_grouped():
+    """Satellite pin for the GQA MFU fix: MHA == heads_kv=heads == the
+    default, and grouping strictly reduces the count by EXACTLY the two
+    grouped terms — kv projection ``2*B*dim*2*(H-Hkv)*D`` plus cache
+    attention ``4*B*span*(H-Hkv)*D``.  An off-by-H regression (charging
+    full width anywhere) breaks the analytic delta."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+        decode_step_flops,
+    )
+
+    b, span, dim, h, d = 8, 4096, 512, 8, 64
+    mha = decode_step_flops(b, span, dim, h, d)
+    assert mha == decode_step_flops(b, span, dim, h, d, heads_kv=h)
+    assert mha == decode_step_flops(b, span, dim, h, d, heads_kv=None)
+
+    hkv = h // 4
+    gqa = decode_step_flops(b, span, dim, h, d, heads_kv=hkv)
+    assert gqa < mha
+    delta = 2.0 * b * dim * 2 * (h - hkv) * d + 4.0 * b * span * (h - hkv) * d
+    assert mha - gqa == delta
+
+    # depth scales the per-layer part; vocab adds the logits matmul once
+    assert decode_step_flops(b, span, dim, h, d, heads_kv=hkv, depth=3) == 3 * gqa
+    assert (decode_step_flops(b, span, dim, h, d, heads_kv=hkv, vocab=1000)
+            == gqa + 2.0 * b * dim * 1000)
+
+    with pytest.raises(ValueError):
+        decode_step_flops(b, span, dim, h, d, heads_kv=0)
+    with pytest.raises(ValueError):
+        decode_step_flops(b, span, dim, h, d, heads_kv=h + 1)
+
+
 def test_measure_throughput_public_api(monkeypatch):
     """Supported benchmark path: sane numbers, MFU populated when a peak is
     known, and the trainer's state restored untouched."""
