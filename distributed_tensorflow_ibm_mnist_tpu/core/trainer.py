@@ -125,7 +125,7 @@ class Trainer:
     """Owns the compiled functions + train state for one run."""
 
     def __init__(self, config: RunConfig, mesh=None, writer: MetricWriter | None = None,
-                 chaos=None, tracer=None):
+                 chaos=None, tracer=None, telemetry=None):
         self.config = config
         # utils/chaos.FaultInjector | None — every chaos site below guards
         # with `is not None`, so an unwired trainer runs zero chaos
@@ -135,6 +135,15 @@ class Trainer:
         # per-epoch dispatch/fetch spans, per-chunk H2D/dispatch spans in
         # stream mode, checkpoint/restore events (docs/OBSERVABILITY.md)
         self._tracer = tracer
+        # utils/telemetry.Telemetry | None — same nil-guard contract.
+        # fit() stamps a heartbeat + step gauge at each fetch interval and
+        # lets the sampler snapshot trainer vitals alongside the serving
+        # tier's (one shared Telemetry gives one cluster time-series)
+        self._telemetry = telemetry
+        self._tel_epochs = 0
+        self._tel_step: int | None = None
+        if telemetry is not None:
+            telemetry.register_source("trainer", self._telemetry_vitals)
         # compile accounting is always on (process-global listener, zero
         # cost between compiles): fit() reports the programs IT compiled
         from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
@@ -634,6 +643,15 @@ class Trainer:
             from distributed_tensorflow_ibm_mnist_tpu.utils.checkpoint import CheckpointManager
 
             self._ckpt = CheckpointManager(config.checkpoint_dir, chaos=chaos)
+
+    def _telemetry_vitals(self) -> dict:
+        """Health-sampler source (utils/telemetry): training progress as
+        O(1) host reads — no device sync, safe every sampling interval."""
+        return {
+            "epochs_done": self._tel_epochs,
+            "weight_step": self._tel_step,
+            "history_len": len(self.history),
+        }
 
     def _make_pipeline_fn(self):
         """The pp>1 block-stack hook: GPipe island when the batch divides
@@ -1675,6 +1693,13 @@ class Trainer:
                             time_to_target = time.perf_counter() - t0
                     self.history.append(record)
                     self.writer.write("epoch", step=step0 + self.steps_per_epoch * (ep + 1), **record)
+                    if self._telemetry is not None:
+                        self._tel_epochs += 1
+                        self._tel_step = step0 + self.steps_per_epoch * (ep + 1)
+                        self._telemetry.heartbeat("trainer")
+                        self._telemetry.set_gauge("trainer_step",
+                                                  self._tel_step)
+                        self._telemetry.maybe_sample()
                 pending.clear()
                 if ckpt_now:
                     self.save_checkpoint(wait=False)

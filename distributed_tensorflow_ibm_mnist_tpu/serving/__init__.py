@@ -43,6 +43,17 @@ attached to the requests they hit); ``tracer.export_trace(path)`` writes a
 Chrome-/Perfetto-loadable timeline and ``scripts/trace_report.py`` renders
 it as a per-phase latency table.  See docs/OBSERVABILITY.md.
 
+Live telemetry (ISSUE 11): pass ``telemetry=`` (utils/telemetry.Telemetry)
+to the engine, router, and trainer — same nil-guard zero-cost-off contract
+— and the health sampler snapshots their vitals (queue depth, slot/pool
+occupancy, per-replica state + last-progress heartbeat) every
+``interval_s`` into an append-mode JSONL time-series plus a Prometheus
+text file; requests may declare ``(ttft_slo_s, tpot_slo_s)`` latency SLOs
+that the engine judges at first token and retirement, flowing
+``slo_met``/``slo_miss``/``goodput_rps`` through :class:`~.stats.
+ServingStats` and the router rollup (``stats.slo_verdict`` is the
+met/miss rule; ``scripts/telemetry_report.py`` renders the time-series).
+
 See docs/SERVING.md for the architecture and knobs.
 """
 
@@ -70,7 +81,10 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     QueueFull,
     Request,
 )
-from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
+from distributed_tensorflow_ibm_mnist_tpu.serving.stats import (
+    ServingStats,
+    slo_verdict,
+)
 
 __all__ = [
     "EngineStalled",
@@ -90,4 +104,5 @@ __all__ = [
     "WeightWatcher",
     "init_paged_cache",
     "pages_needed",
+    "slo_verdict",
 ]

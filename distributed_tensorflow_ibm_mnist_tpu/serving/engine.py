@@ -255,7 +255,8 @@ class InferenceEngine:
                  clock: Callable[[], float] = time.monotonic,
                  stall_timeout_s: float | None = None,
                  compile_cache_dir: str | None = None,
-                 chaos=None, tracer=None, trace_tid: int = 0):
+                 chaos=None, tracer=None, trace_tid: int = 0,
+                 telemetry=None):
         if stall_timeout_s is not None and stall_timeout_s <= 0:
             raise ValueError(
                 f"stall_timeout_s must be > 0 (None disables the watchdog), "
@@ -634,11 +635,41 @@ class InferenceEngine:
         self.stall_timeout_s = stall_timeout_s
         self._chaos = chaos  # utils/chaos.FaultInjector | None (see module doc)
         self._last_progress_t: float | None = None  # watchdog anchor
+        # the anchor above resets on a fatal fault (retry-after-fatal must
+        # restart the stall countdown); this stamp never does — it is the
+        # "when did this engine last make progress" heartbeat the health
+        # sampler reports, frozen at its final value after a kill
+        self._last_progress_ever: float | None = None
+        # utils/telemetry.Telemetry | None — same nil-guard zero-cost-off
+        # contract as _chaos/_tracer.  The engine registers a vitals
+        # source under its trace track id (a Router's replicas get unique
+        # tids, so a respawn REPLACES its predecessor's source) and calls
+        # maybe_sample once per step — a clock read between samples.
+        self._telemetry = telemetry
+        if telemetry is not None:
+            telemetry.register_source(f"engine{trace_tid}",
+                                      self._telemetry_vitals)
         self._draining = False  # drain(): serve what's accepted, admit no more
         self._closed = False
         # per-chip footprint stamped up front: even a run that serves zero
         # requests reports what the config costs one chip (ISSUE 10)
         self._stamp_memory()
+
+    def _telemetry_vitals(self) -> dict:
+        """Health-sampler vitals (utils/telemetry): queue/slot/pool state
+        plus the stats counters, all O(1) reads — safe every interval."""
+        v = self.stats.vitals()
+        v.update(
+            queue_depth=len(self.scheduler),
+            parked=len(self._pending),
+            overcommit_stalled=len(self._stalled_ids),
+            occupied_slots=self.occupied,
+            slots=self.slots,
+            draining=self._draining,
+            closed=self._closed,
+            last_progress_t=self._last_progress_t,
+        )
+        return v
 
     def _stamp_memory(self) -> None:
         """(Re-)stamp the per-chip memory figures into ``self.stats`` —
@@ -716,19 +747,25 @@ class InferenceEngine:
     # request lifecycle
 
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
-               callback: Callable | None = None) -> Request:
+               callback: Callable | None = None,
+               ttft_slo_s: float | None = None,
+               tpot_slo_s: float | None = None) -> Request:
         """Enqueue a request (see :meth:`FIFOScheduler.submit` for the
         admission rules; raises ``QueueFull`` under backpressure).
         ``callback(request, token)`` streams every generated token; if it
         raises, THIS request fails (terminal ``failed`` state) and the
-        engine keeps serving the rest.  Refused after :meth:`drain` /
-        :meth:`close`."""
+        engine keeps serving the rest.  ``ttft_slo_s``/``tpot_slo_s``
+        declare latency SLO targets the engine judges at first token and
+        retirement (never cancels — accounting only; serving/stats.py).
+        Refused after :meth:`drain` / :meth:`close`."""
         if self._closed or self._draining:
             raise RuntimeError(
                 "engine is " + ("closed" if self._closed else "draining")
                 + " — no new requests")
         return self.scheduler.submit(prompt, max_new, deadline_s=deadline_s,
-                                     callback=callback)
+                                     callback=callback,
+                                     ttft_slo_s=ttft_slo_s,
+                                     tpot_slo_s=tpot_slo_s)
 
     @property
     def occupied(self) -> int:
@@ -798,6 +835,18 @@ class InferenceEngine:
         req = self._slot_req[slot]
         req.status = status
         req.finish_t = now
+        # TPOT SLO verdict at retirement: mean seconds per output token
+        # AFTER the first (the decode steady-state the SLO names).  A
+        # single-token request has no inter-token interval — trivially ok.
+        if req.tpot_slo_s is not None and status == "done":
+            n = len(req.generated)
+            if req.first_token_t is not None and n > 1:
+                req.slo_tpot_ok = (
+                    (now - req.first_token_t) / (n - 1) <= req.tpot_slo_s)
+            else:
+                req.slo_tpot_ok = True
+        if self._telemetry is not None and status == "done":
+            self._telemetry.observe("latency_s", now - req.submit_t)
         self._slot_req[slot] = None
         self._release_slot_alloc(slot)  # paged: queue its pages for release
         self._active_dev = None  # occupancy changed; next window re-freezes
@@ -1058,6 +1107,22 @@ class InferenceEngine:
             req.admit_t = now
             req.generated.append(first)
             req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
+            # first token = progress: stamp the heartbeat here too, so an
+            # engine killed later in this same step (before the end-of-step
+            # stamp) still freezes at a real progress time, not None
+            self._last_progress_ever = req.first_token_t
+            # TTFT SLO verdict lands HERE, at the judgment point itself —
+            # queue wait is inside TTFT by construction (stats docstring)
+            if req.ttft_slo_s is not None:
+                req.slo_ttft_ok = (
+                    req.first_token_t - req.submit_t <= req.ttft_slo_s)
+            if self._telemetry is not None:
+                self._telemetry.observe(
+                    "ttft_s", req.first_token_t - req.submit_t)
+                # step()'s `produced` counts decode-window tokens only;
+                # the admit-time first token lands here so the registry
+                # counter matches stats' tokens_generated
+                self._telemetry.inc("tokens_generated")
             req.status = "running"
             self._tr_instant(req, "first_token", slot=slot,
                              cache_hit=cache_hit)
@@ -1393,6 +1458,7 @@ class InferenceEngine:
 
         if produced > 0 or admitted or self.occupied == 0:
             self._last_progress_t = self.clock()
+            self._last_progress_ever = self._last_progress_t
         if self._pool is not None:
             self.stats.pool_sample(self._pool.allocated, self._pool.capacity,
                                    self._page_size, self._page_bytes)
@@ -1406,6 +1472,10 @@ class InferenceEngine:
                                  tid=self._trace_tid)
             self._tracer.counter("occupied_slots", self.occupied,
                                  tid=self._trace_tid)
+        if self._telemetry is not None:
+            if produced:
+                self._telemetry.inc("tokens_generated", int(produced))
+            self._telemetry.maybe_sample()  # clock + compare between samples
         return produced
 
     def _fail_in_flight(self, exc: BaseException, now: float) -> None:

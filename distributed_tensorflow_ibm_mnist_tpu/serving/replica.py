@@ -85,6 +85,11 @@ class Replica:
         # or shutdown); the router folds these + the live engine's stats
         # into the cluster rollup
         self.stats_records: list = []
+        # last non-None engine progress stamp seen by vitals(): the
+        # engine's fault path resets its own watchdog anchor, so the
+        # health sampler needs this copy to show a killed replica's
+        # heartbeat FROZEN at its final progress instead of null
+        self._heartbeat_t: float | None = None
 
     def spawn(self) -> float:
         """Build a fresh engine via the factory and mark HEALTHY.  Returns
@@ -132,6 +137,28 @@ class Replica:
         frac = (e._pool.allocated / e._pool.capacity
                 if e._pool is not None else e.occupied / e.slots)
         return ahead + frac
+
+    def vitals(self) -> dict:
+        """Health-sampler vitals for the router's telemetry source
+        (utils/telemetry): state, spawn/swap counts, served weight step,
+        the load score, and the engine's last-progress heartbeat.  A
+        killed replica stays VISIBLE in every sample — ``state`` goes
+        ``failed``, ``heartbeat_t`` freezes at its last observed progress
+        (None only if it never made any) — instead of vanishing from the
+        dict."""
+        e = self.engine
+        if e is not None and e._last_progress_ever is not None:
+            self._heartbeat_t = e._last_progress_ever
+        return {
+            "state": self.state,
+            "alive": self.alive,
+            "spawns": self.spawns,
+            "swaps": self.swaps,
+            "weight_step": self.weight_step,
+            "spawn_s": self.spawn_s,
+            "load": self.load if self.alive else None,
+            "heartbeat_t": self._heartbeat_t,
+        }
 
     def close(self) -> None:
         """Close the live engine (if any) and bank its stats record for

@@ -97,13 +97,22 @@ class RouterRequest:
 
     def __init__(self, rid: int, tokens, max_new: int,
                  deadline_s: float | None, submit_t: float,
-                 callback: Callable | None):
+                 callback: Callable | None,
+                 ttft_slo_s: float | None = None,
+                 tpot_slo_s: float | None = None):
         self.id = rid
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.deadline_s = deadline_s      # relative to submit_t, like Request
         self.submit_t = submit_t          # router clock at FIRST dispatch
         self.callback = callback          # the USER's hook; router wraps it
+        # SLO targets ride along to every attempt's engine Request.  The
+        # SLO clock is PER-ATTEMPT (each attempt's submit_t), matching
+        # deadline_s semantics: a failed-over attempt is judged on its own
+        # service time, and the failover cost itself shows up as the dead
+        # attempt's miss in the merged slo_miss counter
+        self.ttft_slo_s = ttft_slo_s
+        self.tpot_slo_s = tpot_slo_s
         self.req: Request | None = None   # current engine attempt
         self.replica: int | None = None   # current attempt's replica index
         self.attempts: list[tuple[int, Request]] = []
@@ -173,12 +182,21 @@ class Router:
                  clock: Callable[[], float] = time.monotonic,
                  chaos=None, tracer=None, writer=None,
                  probe: Callable | None = None,
-                 max_drain_steps: int = 10_000):
+                 max_drain_steps: int = 10_000,
+                 telemetry=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.clock = clock
         self._chaos = chaos
         self._tracer = tracer
+        # utils/telemetry.Telemetry | None, nil-guarded like _chaos.  The
+        # router's source reports cluster state + per-replica vitals
+        # (state/load/heartbeat — serving/replica.Replica.vitals); wire
+        # the SAME object into the factory's engines for per-engine
+        # queue/pool vitals alongside
+        self._telemetry = telemetry
+        if telemetry is not None:
+            telemetry.register_source("router", self._telemetry_vitals)
         self.writer = writer
         self._probe = probe
         self.max_drain_steps = int(max_drain_steps)
@@ -212,15 +230,20 @@ class Router:
         return [r for r in self.replicas if r.state == HEALTHY and r.alive]
 
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
-               callback: Callable | None = None) -> RouterRequest:
+               callback: Callable | None = None,
+               ttft_slo_s: float | None = None,
+               tpot_slo_s: float | None = None) -> RouterRequest:
         """Place one request on the least-loaded healthy replica.  Raises
         :class:`NoHealthyReplica` when no replica can be tried and
         :class:`QueueFull` when every healthy replica's queue is at bound
-        (backpressure — the caller sheds or retries, as with one engine)."""
+        (backpressure — the caller sheds or retries, as with one engine).
+        ``ttft_slo_s``/``tpot_slo_s`` ride to every attempt (see
+        :class:`RouterRequest` for the per-attempt clock semantics)."""
         if self._closed:
             raise RuntimeError("router is closed")
         rr = RouterRequest(next(self._ids), prompt, max_new, deadline_s,
-                           self.clock(), callback)
+                           self.clock(), callback,
+                           ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s)
         self._dispatch(rr)   # propagates QueueFull / NoHealthyReplica
         self.requests.append(rr)
         return rr
@@ -280,7 +303,9 @@ class Router:
             try:
                 req = rep.engine.submit(rr.tokens, rr.max_new,
                                         deadline_s=remaining,
-                                        callback=self._wrap_callback(rr))
+                                        callback=self._wrap_callback(rr),
+                                        ttft_slo_s=rr.ttft_slo_s,
+                                        tpot_slo_s=rr.tpot_slo_s)
             except QueueFull:
                 full.append(rep)
                 continue
@@ -321,7 +346,24 @@ class Router:
                 self._fail_replica(rep, e)
         if self._orphans:
             self._retry_orphans()
+        if self._telemetry is not None:
+            self._telemetry.maybe_sample()
         return produced
+
+    def _telemetry_vitals(self) -> dict:
+        """Health-sampler source: cluster counters + per-replica vitals
+        (every replica, dead or alive — a killed replica's ``state`` /
+        frozen ``heartbeat_t`` must stay visible in the time-series)."""
+        return {
+            "n_replicas": len(self.replicas),
+            "healthy": len(self.healthy()),
+            "failovers": self.failovers,
+            "orphans": len(self._orphans),
+            "router_requests": len(self.requests),
+            "outstanding": sum(1 for rr in self.requests if not rr.done),
+            "weight_swaps": len(self.swapped_steps),
+            "replicas": {str(r.index): r.vitals() for r in self.replicas},
+        }
 
     def _fail_replica(self, rep: Replica, exc: BaseException) -> None:
         rep.state = FAILED

@@ -62,12 +62,24 @@ class Request:
     callback: Callable | None = None    # per-token streaming hook:
     #   callback(request, token) after every generated token; an exception
     #   FAILS this request only (see engine docs)
+    ttft_slo_s: float | None = None     # SLO target: submit -> first token
+    #   on the host, seconds; None = this request declares no TTFT SLO
+    tpot_slo_s: float | None = None     # SLO target: mean seconds per
+    #   output token AFTER the first (decode steady-state); None = no SLO.
+    #   Unlike deadline_s these never cancel anything — the engine judges
+    #   them (slo_ttft_ok at first token, slo_tpot_ok at retirement) and
+    #   ServingStats folds the verdicts into slo_met/slo_miss/goodput
+    #   (ISSUE 11; the accounting ROADMAP item 3's load harness gates on)
     admit_t: float | None = None        # engine: slot admission (prefill)
     first_token_t: float | None = None  # engine: first token on host (TTFT)
     finish_t: float | None = None       # engine: retirement
     generated: list[int] = field(default_factory=list)  # engine: output
     status: str = "queued"
     error: str | None = None            # engine: why status == "failed"
+    slo_ttft_ok: bool | None = None     # engine verdict at first token;
+    #   None = not judged (no SLO declared, or never got a first token)
+    slo_tpot_ok: bool | None = None     # engine verdict at retirement;
+    #   None = not judged (no SLO declared, or not retired "done")
     engine_fault: bool = False          # engine: True when a terminal
     #   failed/cancelled status is COLLATERAL of an engine-wide fault
     #   (stall watchdog, close during an overcommit stall) rather than the
@@ -142,10 +154,13 @@ class FIFOScheduler:
         )
 
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
-               callback: Callable | None = None) -> Request:
+               callback: Callable | None = None,
+               ttft_slo_s: float | None = None,
+               tpot_slo_s: float | None = None) -> Request:
         """Enqueue one request; raises :class:`QueueFull` (backpressure) or
         ``ValueError`` (request can never be served).  ``callback`` is the
-        per-token streaming hook (see :class:`Request`)."""
+        per-token streaming hook; ``ttft_slo_s``/``tpot_slo_s`` are the
+        optional latency SLO targets (see :class:`Request`)."""
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("empty prompt")
@@ -153,6 +168,10 @@ class FIFOScheduler:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if ttft_slo_s is not None and ttft_slo_s <= 0:
+            raise ValueError(f"ttft_slo_s must be > 0, got {ttft_slo_s}")
+        if tpot_slo_s is not None and tpot_slo_s <= 0:
+            raise ValueError(f"tpot_slo_s must be > 0, got {tpot_slo_s}")
         if callback is not None and not callable(callback):
             raise ValueError("callback must be callable")
         if tokens.size + max_new > self.max_len:
@@ -169,6 +188,7 @@ class FIFOScheduler:
         req = Request(id=next(self._ids), tokens=tokens, max_new=int(max_new),
                       bucket=bucket, deadline_s=deadline_s,
                       submit_t=self.clock(), callback=callback,
+                      ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s,
                       prefix_key=prefix_key(bucket, tokens))
         if self.tracer is not None:
             # root span of this request's tree, on its own viewer track;

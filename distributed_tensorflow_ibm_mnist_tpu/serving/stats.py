@@ -37,6 +37,25 @@ The serving analog of the trainer's metrics-of-record discipline
   for ONE k-position forward).  Both are None — never NaN — when their
   denominators are zero, so dense/plain records keep a stable schema.
 
+* **SLO / goodput** (ISSUE 11) — a request may declare latency targets
+  ``(ttft_slo_s, tpot_slo_s)`` (serving/scheduler.Request); the engine
+  judges TTFT at first token and TPOT at retirement.  A *tracked* request
+  (≥1 SLO declared) is **met** iff it retired ``done`` with no judged
+  constraint failed; failed/cancelled tracked requests are misses (the
+  user did not get their tokens in time).  ``goodput_rps`` = SLO-met
+  requests per busy-window second — the overload metric ROADMAP item 3
+  gates on: throughput counts tokens, goodput counts tokens *somebody
+  got in time*.
+* **bounded samples** (ISSUE 11) — counters are exact and O(1), but the
+  percentile SAMPLE lists (``self.requests``) are a seeded reservoir
+  (Algorithm R, ``sample_cap`` records): below the cap every request is
+  kept and percentiles are exact; past it each subsequent request
+  replaces a uniformly random kept one, so a week-long soak holds a
+  uniform sample at fixed memory instead of growing without bound.  For
+  streaming (no-stored-samples) percentiles, see
+  utils/telemetry.HistogramSketch — tier-1 cross-checks the two agree
+  within bucket resolution.
+
 Percentiles are p50/p95/p99 over completed requests (cancelled requests
 count in TTFT if they got a first token, and in the cancel counter, not in
 latency — a deadline kill is not a service time).
@@ -44,10 +63,29 @@ latency — a deadline kill is not a service time).
 
 from __future__ import annotations
 
+import random
+
 import numpy as np
 
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import Request
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+
+
+def slo_verdict(req: "Request") -> str | None:
+    """None = untracked (no SLO declared); else ``"met"`` / ``"miss"``.
+
+    Met requires terminal status ``done`` AND no judged constraint
+    failed.  A tracked request that failed or was cancelled is a miss
+    even when no constraint was ever judged — an answer that never
+    arrived did not meet its latency target.
+    """
+    if req.ttft_slo_s is None and req.tpot_slo_s is None:
+        return None
+    if req.status != "done":
+        return "miss"
+    if req.slo_ttft_ok is False or req.slo_tpot_ok is False:
+        return "miss"
+    return "met"
 
 
 def percentiles(xs, qs=(50, 95, 99)) -> dict[str, float]:
@@ -68,10 +106,32 @@ class ServingStats:
     (non-finite values are sanitized to null by the writer itself).
     """
 
-    def __init__(self, slots: int, decode_ahead: int = 1):
+    def __init__(self, slots: int, decode_ahead: int = 1,
+                 sample_cap: int = 2048):
+        if sample_cap < 1:
+            raise ValueError(f"sample_cap must be >= 1, got {sample_cap}")
         self.slots = slots
         self.decode_ahead = decode_ahead
+        # bounded percentile-sample reservoir (Algorithm R; see module
+        # docstring).  Counters below are EXACT regardless of the cap;
+        # only the percentile samples are subject to reservoir sampling.
+        # Seeded so soak reruns keep identical sample populations.
+        self.sample_cap = int(sample_cap)
         self.requests: list[Request] = []
+        self._rng = random.Random(0)
+        self._n_requests = 0
+        self._n_done = 0
+        self._n_cancelled = 0
+        self._n_failed = 0
+        self._n_engine_fault = 0
+        self._tokens = 0
+        # --- SLO / goodput accounting (ISSUE 11) --- all zero when no
+        # request declares an SLO, so the schema stays stable
+        self._slo_tracked = 0
+        self._slo_met = 0
+        self._slo_miss = 0
+        self._slo_ttft_miss = 0
+        self._slo_tpot_miss = 0
         self._occ_time = 0.0   # integral of occupied_slots * dt
         self._busy_time = 0.0  # integral of dt while the engine had work
         self._decode_steps = 0
@@ -192,7 +252,36 @@ class ServingStats:
         self._compile = delta
 
     def add(self, req: Request) -> None:
-        self.requests.append(req)
+        self._n_requests += 1
+        if req.status == "done":
+            self._n_done += 1
+        elif req.status == "cancelled":
+            self._n_cancelled += 1
+        elif req.status == "failed":
+            self._n_failed += 1
+        if req.engine_fault:
+            self._n_engine_fault += 1
+        self._tokens += len(req.generated)
+        verdict = slo_verdict(req)
+        if verdict is not None:
+            self._slo_tracked += 1
+            if verdict == "met":
+                self._slo_met += 1
+            else:
+                self._slo_miss += 1
+                # per-constraint attribution; a miss judged on neither
+                # constraint (failed/cancelled before any verdict) counts
+                # in slo_miss only
+                if req.slo_ttft_ok is False:
+                    self._slo_ttft_miss += 1
+                if req.slo_tpot_ok is False:
+                    self._slo_tpot_miss += 1
+        if len(self.requests) < self.sample_cap:
+            self.requests.append(req)
+        else:
+            j = self._rng.randrange(self._n_requests)
+            if j < self.sample_cap:
+                self.requests[j] = req
         if req.admit_t is not None:
             self._start_t = req.admit_t if self._start_t is None else min(
                 self._start_t, req.admit_t)
@@ -201,14 +290,13 @@ class ServingStats:
                 self._end_t, req.finish_t)
 
     def summary(self) -> dict:
+        # counters are exact; ttft/latency percentiles are computed over
+        # the bounded reservoir (exact below sample_cap)
         done = [r for r in self.requests if r.status == "done"]
-        cancelled = [r for r in self.requests if r.status == "cancelled"]
-        failed = [r for r in self.requests if r.status == "failed"]
         ttft = [r.first_token_t - r.submit_t for r in self.requests
                 if r.first_token_t is not None]
         latency = [r.finish_t - r.submit_t for r in done
                    if r.finish_t is not None]
-        n_tokens = sum(len(r.generated) for r in self.requests)
         window = (
             (self._end_t - self._start_t)
             if self._start_t is not None and self._end_t is not None
@@ -216,13 +304,30 @@ class ServingStats:
         )
         out = {
             "slots": self.slots,
-            "n_requests": len(self.requests),
-            "n_done": len(done),
-            "n_cancelled": len(cancelled),
-            "n_failed": len(failed),
-            "tokens_generated": int(n_tokens),
+            "n_requests": self._n_requests,
+            "n_done": self._n_done,
+            "n_cancelled": self._n_cancelled,
+            "n_failed": self._n_failed,
+            "tokens_generated": int(self._tokens),
             "tokens_per_sec": (
-                round(n_tokens / window, 3) if window else None
+                round(self._tokens / window, 3) if window else None
+            ),
+            "sample_cap": self.sample_cap,
+            "percentile_samples": len(self.requests),
+            # SLO / goodput (ISSUE 11): tracked = requests that declared
+            # ≥1 SLO; goodput = SLO-met requests per busy-window second
+            "slo_tracked": self._slo_tracked,
+            "slo_met": self._slo_met,
+            "slo_miss": self._slo_miss,
+            "slo_ttft_miss": self._slo_ttft_miss,
+            "slo_tpot_miss": self._slo_tpot_miss,
+            "slo_met_rate": (
+                round(self._slo_met / self._slo_tracked, 4)
+                if self._slo_tracked > 0 else None
+            ),
+            "goodput_rps": (
+                round(self._slo_met / window, 3)
+                if window and self._slo_tracked > 0 else None
             ),
             "busy_s": round(self._busy_time, 6),
             "decode_steps": self._decode_steps,
@@ -299,6 +404,31 @@ class ServingStats:
                 out[f"{name}_{k}"] = v
         return out
 
+    def vitals(self) -> dict:
+        """Cheap live subset for the telemetry health sampler
+        (utils/telemetry.Telemetry): counters and rates only, no
+        percentile work, safe to call every sampling interval."""
+        p_total = self._prefix_hits + self._prefix_misses
+        r_total = self._radix_hits + self._radix_misses
+        return {
+            "n_requests": self._n_requests,
+            "n_done": self._n_done,
+            "n_cancelled": self._n_cancelled,
+            "n_failed": self._n_failed,
+            "tokens_generated": self._tokens,
+            "prefix_hit_rate": (round(self._prefix_hits / p_total, 4)
+                                if p_total > 0 else None),
+            "radix_hit_rate": (round(self._radix_hits / r_total, 4)
+                               if r_total > 0 else None),
+            "accept_rate": (round(self._spec_accepted / self._spec_drafted, 4)
+                            if self._spec_drafted > 0 else None),
+            "kv_pages_live": self._kv_pages_live,
+            "kv_pages_total": self._kv_pages_total,
+            "slo_tracked": self._slo_tracked,
+            "slo_met": self._slo_met,
+            "slo_miss": self._slo_miss,
+        }
+
     def emit(self, writer: MetricWriter, kind: str = "serving") -> dict:
         return writer.write(kind, **self.summary())
 
@@ -315,6 +445,13 @@ class ServingStats:
         bound on the cluster's concurrent peak (per-engine peaks need not
         align in time).  ``per_engine`` carries each engine's own summary
         as a sub-record, so the rollup never hides a sick replica.
+
+        Counters come from each record's EXACT counters; percentiles are
+        recomputed over the union of the per-engine sample reservoirs
+        (exact while every engine stayed below its ``sample_cap``).
+        SLO counters sum and ``slo_met_rate``/``goodput_rps`` re-derive
+        over the merged totals, so the cluster goodput is met-requests
+        per second of the CLUSTER's busy window, not a mean of rates.
         """
         reqs = [r for rec in records for r in rec.requests]
         done = [r for r in reqs if r.status == "done"]
@@ -322,7 +459,9 @@ class ServingStats:
                 if r.first_token_t is not None]
         latency = [r.finish_t - r.submit_t for r in done
                    if r.finish_t is not None]
-        n_tokens = sum(len(r.generated) for r in reqs)
+        n_tokens = sum(rec._tokens for rec in records)
+        slo_tracked = sum(rec._slo_tracked for rec in records)
+        slo_met = sum(rec._slo_met for rec in records)
         starts = [rec._start_t for rec in records if rec._start_t is not None]
         ends = [rec._end_t for rec in records if rec._end_t is not None]
         window = (max(ends) - min(starts)
@@ -350,13 +489,23 @@ class ServingStats:
         out = {
             "n_engines": len(records),
             "slots": slots,
-            "n_requests": len(reqs),
-            "n_done": len(done),
-            "n_cancelled": sum(r.status == "cancelled" for r in reqs),
-            "n_failed": sum(r.status == "failed" for r in reqs),
-            "n_engine_fault": sum(r.engine_fault for r in reqs),
+            "n_requests": sum(rec._n_requests for rec in records),
+            "n_done": sum(rec._n_done for rec in records),
+            "n_cancelled": sum(rec._n_cancelled for rec in records),
+            "n_failed": sum(rec._n_failed for rec in records),
+            "n_engine_fault": sum(rec._n_engine_fault for rec in records),
             "tokens_generated": int(n_tokens),
             "tokens_per_sec": (round(n_tokens / window, 3) if window else None),
+            "percentile_samples": len(reqs),
+            "slo_tracked": slo_tracked,
+            "slo_met": slo_met,
+            "slo_miss": sum(rec._slo_miss for rec in records),
+            "slo_ttft_miss": sum(rec._slo_ttft_miss for rec in records),
+            "slo_tpot_miss": sum(rec._slo_tpot_miss for rec in records),
+            "slo_met_rate": (round(slo_met / slo_tracked, 4)
+                             if slo_tracked > 0 else None),
+            "goodput_rps": (round(slo_met / window, 3)
+                            if window and slo_tracked > 0 else None),
             "busy_s": round(sum(rec._busy_time for rec in records), 6),
             "decode_steps": sum(rec._decode_steps for rec in records),
             "slot_occupancy": (round(occ_time / busy_weighted, 4)

@@ -55,6 +55,18 @@ Two more legs (ISSUE 6, observability):
   whole decode step is ~200us of host Python and ANY per-window event
   model breaches 2% by arithmetic (see docs/OBSERVABILITY.md §Overhead).
 
+Two more legs (ISSUE 11, live telemetry):
+
+* **telemetry_overhead** — the tracer_overhead pairing applied to the
+  telemetry hooks: telemetry-off vs an engine wired to a live sampler
+  (0.1 s interval, real JSONL + Prometheus writes).  Unlike the tracer
+  figure this one is GATED: ``overhead_frac > 2%`` exits nonzero.
+* **slo_goodput** — 4x-slots requests queued at once, half with an
+  impossible TTFT SLO and half unmissable, plus an unloaded control leg:
+  the met/miss/goodput counters must come out EXACTLY right (arithmetic
+  gates, not timing thresholds) and ``ServingStats.merge`` must sum them
+  — any gate failing exits nonzero.
+
 Two more legs (ISSUE 7, paged KV):
 
 * **compile_census** additionally serves a PAGED engine (``kv_page_size``
@@ -694,6 +706,187 @@ def run_tracer_overhead(slots: int, requests: int) -> dict:
     }
 
 
+def run_telemetry_overhead(slots: int, requests: int) -> dict:
+    """Telemetry cost on the same primary regime, measured the same PAIRED
+    way as ``run_tracer_overhead`` (back-to-back off/on reps, alternating
+    order, GC swept, median within-pair ratio): a telemetry-off engine vs
+    one wired to a live :class:`Telemetry` sampling every 0.1 s into real
+    JSONL + Prometheus files.  The wired-on cost is per-request histogram
+    observes, a per-step counter, a per-step clock compare, and the
+    interval's sample writes — the nil-guard contract keeps wired-off at
+    one attribute test.  Target: <= 2% (breach exits the bench nonzero —
+    unlike the tracer this budget is a hard gate).  The dim-32 toy-regime
+    caveat from ``run_tracer_overhead`` applies identically."""
+    import gc
+    import tempfile
+
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+        ServingStats,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.telemetry import Telemetry
+
+    max_len = BUCKET + LONG_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DIM,
+                      depth=DEPTH, heads=HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(6),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    stream = make_stream(requests, seed=8)
+    warm = make_stream(max(slots * 2, 8), seed=9)
+    k = DA_KS[-1]
+
+    def build(telemetry):
+        eng = InferenceEngine(
+            model, params, slots=slots, max_len=max_len,
+            telemetry=telemetry, decode_ahead=k,
+            scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET,),
+                                    max_queue=max(len(stream), len(warm))))
+        for p, mn in warm:
+            eng.submit(p, max_new=mn)
+        eng.run()
+        return eng
+
+    def timed(eng):
+        eng.completed.clear()
+        eng.stats = ServingStats(eng.slots, decode_ahead=eng.decode_ahead)
+        t0 = time.perf_counter()
+        for p, mn in stream:
+            eng.submit(p, max_new=mn)
+        eng.run()
+        return time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as td:
+        telemetry = Telemetry(interval_s=0.1,
+                              jsonl_path=f"{td}/telemetry.jsonl",
+                              prom_path=f"{td}/telemetry.prom")
+        eng_off, eng_on = build(None), build(telemetry)
+        reps = 10
+        off_ts: list[float] = []
+        on_ts: list[float] = []
+        for i in range(reps):
+            pair = ((eng_off, eng_on) if i % 2 == 0 else (eng_on, eng_off))
+            for eng in pair:
+                gc.collect()
+                t = timed(eng)
+                (off_ts if eng is eng_off else on_ts).append(t)
+        samples = telemetry.samples
+        telemetry.close()
+    ratios = sorted(b / a for a, b in zip(off_ts, on_ts))
+    mid = len(ratios) // 2
+    median_ratio = (ratios[mid] if len(ratios) % 2
+                    else (ratios[mid - 1] + ratios[mid]) / 2.0)
+    return {
+        "n_requests": len(stream),
+        "decode_ahead": k,
+        "interval_s": 0.1,
+        "off_s": round(min(off_ts), 4),
+        "on_s": round(min(on_ts), 4),
+        "overhead_frac": round(median_ratio - 1.0, 4),
+        "target_frac": 0.02,
+        "n_samples": samples,
+    }
+
+
+def run_slo_goodput(slots: int) -> dict:
+    """SLO/goodput counters move CORRECTLY on an overloaded stream.
+
+    One warmed primary-regime engine serves 4x-slots requests submitted
+    at once (the queue is the overload), split between an impossible
+    TTFT SLO (1e-6 s — below one jit dispatch, so every such request
+    MUST miss at first token) and an unmissable one (1e4 s — met iff the
+    request completes).  A second, unloaded leg (slots requests, all
+    unmissable) must meet everything.  The gates are arithmetic, not
+    timing-sensitive: met + miss == tracked on each leg, the tight half
+    misses exactly, the generous half and the unloaded leg meet exactly,
+    goodput is reported, and ``ServingStats.merge`` across the two legs
+    sums the counters — the same rollup the router applies per replica.
+    Any gate failing exits the bench nonzero."""
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+        ServingStats,
+    )
+
+    max_len = BUCKET + LONG_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DIM,
+                      depth=DEPTH, heads=HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(7),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n = 4 * slots
+    n_tight = (n + 1) // 2
+    stream = make_stream(n, seed=10)
+    warm = make_stream(max(slots * 2, 8), seed=11)
+    eng = InferenceEngine(
+        model, params, slots=slots, max_len=max_len,
+        decode_ahead=DA_KS[-1],
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET,),
+                                max_queue=n + len(warm)))
+    for p, mn in warm:
+        eng.submit(p, max_new=mn)
+    eng.run()
+
+    # overloaded leg: every request queued up front, alternating SLOs
+    eng.completed.clear()
+    eng.stats = ServingStats(slots, decode_ahead=eng.decode_ahead)
+    t0 = time.perf_counter()
+    for i, (p, mn) in enumerate(stream):
+        eng.submit(p, max_new=mn,
+                   ttft_slo_s=(1e-6 if i % 2 == 0 else 1e4),
+                   tpot_slo_s=1e4)
+    eng.run()
+    over_s = time.perf_counter() - t0
+    over_stats = eng.stats
+    over = over_stats.summary()
+
+    # unloaded leg: fits the slots, all SLOs unmissable
+    eng.completed.clear()
+    eng.stats = ServingStats(slots, decode_ahead=eng.decode_ahead)
+    for p, mn in make_stream(slots, seed=12):
+        eng.submit(p, max_new=mn, ttft_slo_s=1e4, tpot_slo_s=1e4)
+    eng.run()
+    un = eng.stats.summary()
+    merged = ServingStats.merge([over_stats, eng.stats])
+
+    gates = {
+        "overloaded_conservation": (
+            over["slo_met"] + over["slo_miss"] == over["slo_tracked"] == n),
+        "tight_half_missed": (over["slo_miss"] == n_tight
+                              and over["slo_ttft_miss"] == n_tight),
+        "generous_half_met": over["slo_met"] == n - n_tight,
+        "unloaded_all_met": (un["slo_met"] == un["slo_tracked"] == slots
+                             and un["slo_miss"] == 0),
+        "goodput_reported": (over["goodput_rps"] is not None
+                             and un["goodput_rps"] is not None),
+        "merge_sums_counters": (
+            merged["slo_tracked"] == n + slots
+            and merged["slo_met"] == over["slo_met"] + un["slo_met"]
+            and merged["slo_miss"] == over["slo_miss"]),
+    }
+    return {
+        "slots": slots,
+        "overloaded_requests": n,
+        "overloaded_s": round(over_s, 4),
+        "slo_tracked": over["slo_tracked"],
+        "slo_met": over["slo_met"],
+        "slo_miss": over["slo_miss"],
+        "slo_ttft_miss": over["slo_ttft_miss"],
+        "slo_met_rate": over["slo_met_rate"],
+        "goodput_rps": over["goodput_rps"],
+        # queue-inflation visibility: under overload the p99 TTFT carries
+        # the queue wait the p50 mostly dodges (reported, not gated —
+        # wall-clock ratios on a shared host are noise)
+        "ttft_s_p50": over["ttft_s_p50"],
+        "ttft_s_p99": over["ttft_s_p99"],
+        "unloaded_goodput_rps": un["goodput_rps"],
+        "merged_slo_met_rate": merged["slo_met_rate"],
+        "gates": gates,
+        "gates_ok": all(gates.values()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=32)
@@ -784,6 +977,9 @@ def main() -> None:
         "compile_cache": run_compile_cache(),
         "tracer_overhead": run_tracer_overhead(
             args.slots, 16 if QUICK else 24),
+        "telemetry_overhead": run_telemetry_overhead(
+            args.slots, 16 if QUICK else 24),
+        "slo_goodput": run_slo_goodput(args.slots),
         "quick": QUICK,
         "device": str(jax.devices()[0]),
         "note": (
@@ -800,6 +996,20 @@ def main() -> None:
     if not result["compile_census"]["census_ok"]:
         print(f"compile census over budget: "
               f"{result['compile_census']['over_budget']}", file=sys.stderr)
+        sys.exit(3)
+    # the telemetry GATE (ISSUE 11): wired-on sampling must stay within
+    # its <=2% budget — unlike tracer_overhead (reported, not gated) this
+    # is the acceptance bar for the zero-cost-off contract's ON side
+    tel = result["telemetry_overhead"]
+    if tel["overhead_frac"] > tel["target_frac"]:
+        print(f"telemetry overhead over budget: {tel['overhead_frac']} > "
+              f"{tel['target_frac']}", file=sys.stderr)
+        sys.exit(3)
+    # the SLO/goodput GATE (ISSUE 11): counter arithmetic on the
+    # overloaded stream must hold exactly (see run_slo_goodput)
+    if not result["slo_goodput"]["gates_ok"]:
+        print(f"slo goodput gates failed: {result['slo_goodput']['gates']}",
+              file=sys.stderr)
         sys.exit(3)
 
 

@@ -1,0 +1,242 @@
+"""Time-series rollup of a telemetry JSONL file (utils/telemetry).
+
+Reads the append-mode JSONL the :class:`Telemetry` health sampler writes
+(one strict-JSON record per sampling interval) and prints
+
+* a **run digest** — samples, time span, sources seen, source errors;
+* a **counter table** — per registry counter: first/last value and the
+  mean rate over the sampled span (counters are monotone, so
+  ``(last - first) / span`` is the honest throughput figure);
+* a **gauge table** — per numeric gauge AND per numeric source-vitals
+  leaf (``sources.engine0.queue_depth`` flattens to
+  ``engine0.queue_depth``): min/mean/max/last over the samples — the
+  "what did queue depth / pool occupancy do over the run" view;
+* a **histogram table** — per registry histogram: lifetime count and
+  p50/p95/p99 from the LAST sample (the sampler re-derives them from the
+  full sketch every interval, so the last row is the run's rollup) plus
+  the final rolling-window p99;
+* the **SLO table** — per engine source: tracked/met/miss counters and
+  the met rate, plus the cluster goodput over the sampled span
+  (SLO-met requests per second — the ROADMAP item 3 gated metric).
+
+``--json`` emits the same dict as one machine-readable line.
+``--strict`` exits nonzero on any unparseable line, non-dict record, or
+non-monotonic ``t`` (an interleaved or truncated file) — without it,
+bad lines are counted and skipped.
+
+Usage:
+    python scripts/telemetry_report.py TELEMETRY.jsonl [--json] [--strict]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _numeric_leaves(prefix: str, obj, out: dict) -> None:
+    """Flatten numeric leaves (bools as 0/1) of a nested dict."""
+    if isinstance(obj, bool):
+        out[prefix] = 1.0 if obj else 0.0
+    elif isinstance(obj, (int, float)) and math.isfinite(obj):
+        out[prefix] = float(obj)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _numeric_leaves(f"{prefix}.{k}" if prefix else str(k), v, out)
+
+
+def load_records(path: str) -> tuple[list[dict], list[str]]:
+    """Parse the JSONL file; returns (records, problems)."""
+    records, problems = [], []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {i}: unparseable JSON ({e})")
+                continue
+            if not isinstance(rec, dict) or "t" not in rec:
+                problems.append(f"line {i}: not a telemetry record")
+                continue
+            records.append(rec)
+    for a, b in zip(records, records[1:]):
+        if b["t"] < a["t"]:
+            problems.append(
+                f"non-monotonic t: {a['t']} -> {b['t']} (interleaved "
+                "writers or a truncated/concatenated file)")
+            break
+    return records, problems
+
+
+def analyze(records: list[dict]) -> dict:
+    """Pure rollup of parsed sampler records — also used by tests."""
+    if not records:
+        return {"n_samples": 0, "span_s": None, "sources": [],
+                "source_errors": 0, "counters": {}, "gauges": {},
+                "histograms": {}, "slo": None}
+    t0, t1 = records[0]["t"], records[-1]["t"]
+    span = t1 - t0 if t1 > t0 else None
+    first, last = records[0], records[-1]
+
+    counters = {}
+    for name, end in (last.get("counters") or {}).items():
+        start = (first.get("counters") or {}).get(name, 0)
+        counters[name] = {
+            "first": start, "last": end,
+            "rate_per_s": (round((end - start) / span, 3)
+                           if span else None),
+        }
+
+    # gauges + flattened numeric source vitals, min/mean/max/last
+    tracks: dict[str, list[float]] = {}
+    source_names: set[str] = set()
+    source_errors = 0
+    for rec in records:
+        flat: dict[str, float] = {}
+        for k, v in (rec.get("gauges") or {}).items():
+            _numeric_leaves(k, v, flat)
+        for sname, vitals in (rec.get("sources") or {}).items():
+            source_names.add(sname)
+            if isinstance(vitals, dict) and "error" in vitals:
+                source_errors += 1
+                continue
+            _numeric_leaves(sname, vitals, flat)
+        for k, v in flat.items():
+            tracks.setdefault(k, []).append(v)
+    gauges = {
+        k: {"n": len(vs), "min": min(vs),
+            "mean": round(sum(vs) / len(vs), 4), "max": max(vs),
+            "last": vs[-1]}
+        for k, vs in sorted(tracks.items())
+    }
+
+    histograms = {}
+    for name, h in (last.get("histograms") or {}).items():
+        histograms[name] = {
+            "count": h.get("count"),
+            "p50": h.get("p50"), "p95": h.get("p95"), "p99": h.get("p99"),
+            "window_p99": h.get("window_p99"),
+        }
+
+    # SLO table: per source carrying slo_* vitals, plus the cluster sum.
+    # Rates/goodput re-derive from the LAST sample's counters over the
+    # sampled span (the ServingStats.merge discipline: sums, then ratios).
+    slo_rows = []
+    tot_tracked = tot_met = tot_miss = 0
+    for sname in sorted(source_names):
+        vit = (last.get("sources") or {}).get(sname) or {}
+        if not isinstance(vit, dict) or "slo_tracked" not in vit:
+            continue
+        tracked = vit.get("slo_tracked") or 0
+        met = vit.get("slo_met") or 0
+        miss = vit.get("slo_miss") or 0
+        tot_tracked += tracked
+        tot_met += met
+        tot_miss += miss
+        slo_rows.append({
+            "source": sname, "tracked": tracked, "met": met, "miss": miss,
+            "met_rate": round(met / tracked, 4) if tracked else None,
+        })
+    slo = None
+    if slo_rows:
+        slo = {
+            "per_source": slo_rows,
+            "tracked": tot_tracked, "met": tot_met, "miss": tot_miss,
+            "met_rate": (round(tot_met / tot_tracked, 4)
+                         if tot_tracked else None),
+            "goodput_rps": (round(tot_met / span, 3)
+                            if span and tot_tracked else None),
+        }
+
+    return {
+        "n_samples": len(records),
+        "span_s": round(span, 6) if span else None,
+        "sources": sorted(source_names),
+        "source_errors": source_errors,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "slo": slo,
+    }
+
+
+def _fmt_table(rows: list[dict], cols: list[str]) -> str:
+    if not rows:
+        return "  (none)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    head = "  " + "  ".join(c.ljust(widths[c]) for c in cols)
+    sep = "  " + "  ".join("-" * widths[c] for c in cols)
+    body = ["  " + "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols)
+            for r in rows]
+    return "\n".join([head, sep] + body)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="telemetry JSONL written by the sampler")
+    ap.add_argument("--json", action="store_true", help="emit one JSON line")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on unparseable/non-monotonic records")
+    args = ap.parse_args(argv)
+
+    records, problems = load_records(args.jsonl)
+    if problems and args.strict:
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        return 1
+
+    report = analyze(records)
+    report["problems"] = problems
+    if args.json:
+        json.dump(report, sys.stdout, allow_nan=False)
+        print()
+        return 0
+
+    print(f"telemetry: {args.jsonl}  ({report['n_samples']} samples, "
+          f"span {report['span_s']}s, sources: "
+          f"{', '.join(report['sources']) or '(none)'})")
+    if problems:
+        print(f"\n!! {len(problems)} problem(s):")
+        for p in problems:
+            print(f"  - {p}")
+    if report["source_errors"]:
+        print(f"\n!! {report['source_errors']} source error sample(s)")
+    if report["counters"]:
+        print("\nCounters:")
+        print(_fmt_table(
+            [{"counter": k, **v} for k, v in sorted(
+                report["counters"].items())],
+            ["counter", "first", "last", "rate_per_s"]))
+    if report["gauges"]:
+        print("\nGauges / source vitals (over samples):")
+        print(_fmt_table(
+            [{"track": k, **v} for k, v in report["gauges"].items()],
+            ["track", "n", "min", "mean", "max", "last"]))
+    if report["histograms"]:
+        print("\nHistograms (lifetime; window_p99 = rolling):")
+        print(_fmt_table(
+            [{"histogram": k, **v} for k, v in sorted(
+                report["histograms"].items())],
+            ["histogram", "count", "p50", "p95", "p99", "window_p99"]))
+    if report["slo"]:
+        s = report["slo"]
+        print("\nSLO accounting:")
+        print(_fmt_table(s["per_source"],
+                         ["source", "tracked", "met", "miss", "met_rate"]))
+        print(f"  cluster: tracked={s['tracked']} met={s['met']} "
+              f"miss={s['miss']} met_rate={s['met_rate']} "
+              f"goodput_rps={s['goodput_rps']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,9 @@ viewer-independent identity every exported span carries), and prints
   up its summed draft/verify/accept milliseconds and an ``accept_rate``
   column (accepted/drafted over the request's verify windows);
 * the **instant and counter digest** — faults, restarts, cache hits, and
-  last counter values, so a soak's timeline is summarized without a GUI.
+  per-track counter rollups (``queue_depth``, ``occupied_slots``:
+  min/mean/max/last over the recorded change points — ISSUE 11), so a
+  soak's timeline is summarized without a GUI.
 
 Validation runs first (``validate_trace``): a trace with unclosed spans,
 dangling parents, or non-strict JSON is reported and (with ``--strict``)
@@ -160,9 +162,27 @@ def analyze(doc: dict) -> dict:
         key = f"{e.get('cat', '')}/{e['name']}"
         inst_counts[key] = inst_counts.get(key, 0) + 1
     counter_last: dict[str, float] = {}
+    counter_vals: dict[str, list[float]] = {}
     for e in counters:  # export order is chronological; last write wins
         for k, v in (e.get("args") or {}).items():
-            counter_last[f"{e['name']}.{k}"] = v
+            key = f"{e['name']}.{k}"
+            counter_last[key] = v
+            counter_vals.setdefault(key, []).append(v)
+    # ISSUE 11 satellite: the full track rollup.  Counters are recorded at
+    # their CHANGE points (the tracer dedups repeats), so these are stats
+    # over the sequence of distinct recorded values — min/max bound the
+    # track exactly; mean is the mean recorded value, NOT time-weighted
+    # (a long flat plateau counts once).
+    counter_stats = {
+        key: {
+            "n": len(vals),
+            "min": min(vals),
+            "mean": round(sum(vals) / len(vals), 3),
+            "max": max(vals),
+            "last": counter_last[key],
+        }
+        for key, vals in sorted(counter_vals.items())
+    }
 
     return {
         "n_events": len(events),
@@ -171,6 +191,7 @@ def analyze(doc: dict) -> dict:
         "requests": requests,
         "instants": dict(sorted(inst_counts.items())),
         "counters_last": dict(sorted(counter_last.items())),
+        "counter_stats": counter_stats,
     }
 
 
@@ -243,10 +264,11 @@ def main(argv: list[str] | None = None) -> int:
         print("\nInstant events:")
         for k, v in report["instants"].items():
             print(f"  {k}: {v}")
-    if report["counters_last"]:
-        print("\nCounters (last value):")
-        for k, v in report["counters_last"].items():
-            print(f"  {k}: {v}")
+    if report["counter_stats"]:
+        print("\nCounter tracks (over recorded change points):")
+        print(_fmt_table(
+            [{"track": k, **v} for k, v in report["counter_stats"].items()],
+            ["track", "n", "min", "mean", "max", "last"]))
     return 0
 
 

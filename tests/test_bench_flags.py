@@ -1,0 +1,110 @@
+"""Bench skip-flag coverage (ISSUE 11 satellite).
+
+Two guarantees about ``bench.py``'s block structure:
+
+* The set of ``DTM_BENCH_SKIP_*`` flags bench.py consults is exactly the
+  set the README's "Bench blocks and skip flags" table documents — a new
+  block added without its table row (or a renamed flag that orphans a
+  row) fails tier-1, not code review.
+
+* (slow) Running ``bench.py`` with ``DTM_BENCH_QUICK=1`` and EVERY skip
+  flag set actually skips every block: the run exits 0, the record says
+  ``quick: true``, and none of the gated result keys appear.  This is
+  the only test that executes the bench harness end to end, so it also
+  smoke-tests the quick headline path (tiny synthetic MLP, no compile
+  subprocesses).
+"""
+
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# flag -> result keys its block contributes (absent when skipped).  The
+# README table documents the same mapping prose-side; the slow test
+# asserts it against a real run.
+FLAG_KEYS = {
+    "DTM_BENCH_SKIP_LM": [
+        "lm_tokens_per_sec_per_chip", "lm_mfu", "lm_config",
+        "lm_d128_tokens_per_sec_per_chip", "lm_d128_mfu", "lm_d128_config",
+    ],
+    "DTM_BENCH_SKIP_SHARDED": ["dp_sharded_update"],
+    "DTM_BENCH_SKIP_SERVING": ["serving", "kv_paging"],
+    "DTM_BENCH_SKIP_TP": ["tp_serving"],
+    "DTM_BENCH_SKIP_CHAOS": ["chaos"],
+    "DTM_BENCH_SKIP_ROUTER": ["router"],
+    "DTM_BENCH_SKIP_SPEC": ["speculative"],
+    "DTM_BENCH_SKIP_TRAIN_CENSUS": ["train_census"],
+}
+
+
+def test_skip_flags_match_readme_table():
+    bench_src = (REPO / "bench.py").read_text()
+    readme = (REPO / "README.md").read_text()
+    flag_re = re.compile(r"DTM_BENCH_SKIP_[A-Z_]+")
+
+    # only the flags bench.py actually CHECKS count — comment/docstring
+    # mentions ride along but os.environ.get(...) is the ground truth
+    checked = set(re.findall(r"""environ\.get\(["'](DTM_BENCH_SKIP_[A-Z_]+)""",
+                             bench_src))
+    assert checked == set(FLAG_KEYS), (
+        f"bench.py checks {sorted(checked)} but this test (and the README "
+        f"table) documents {sorted(FLAG_KEYS)} — update both together")
+
+    # the README consolidated table must name every checked flag (and no
+    # stale ones): compare against the table section specifically
+    m = re.search(r"### Bench blocks and skip flags\n(.*?)(?:\n## |\Z)",
+                  readme, re.DOTALL)
+    assert m, "README lost its 'Bench blocks and skip flags' section"
+    documented = set(flag_re.findall(m.group(1)))
+    assert documented == set(FLAG_KEYS), (
+        f"README table documents {sorted(documented)}, bench.py has "
+        f"{sorted(FLAG_KEYS)}")
+
+    # QUICK is documented beside the table too
+    assert "DTM_BENCH_QUICK" in m.group(1)
+
+
+@pytest.mark.slow
+def test_quick_bench_honors_every_skip_flag(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DTM_BENCH_QUICK"] = "1"
+    for flag in FLAG_KEYS:
+        env[flag] = "1"
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=560, env=env, cwd=tmp_path,
+    )
+    assert out.returncode == 0, (
+        f"quick all-skip bench failed rc={out.returncode}; "
+        f"stderr tail: {out.stderr[-800:]!r}")
+
+    rec = None
+    for line in out.stdout.splitlines():
+        try:
+            cand = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            rec = cand
+    assert rec is not None, f"no JSON record in stdout: {out.stdout[-800:]!r}"
+
+    # headline ran (quick form), and flagged it
+    assert rec["quick"] is True
+    assert rec["value"] > 0
+    # quick skips the compile-time subprocess legs
+    assert rec["compile_s_cold"] is None
+    assert rec["compile_s_warm"] is None
+
+    # every skipped block's keys are absent — a flag that silently stops
+    # skipping shows up here as its key reappearing
+    for flag, keys in FLAG_KEYS.items():
+        for key in keys:
+            assert key not in rec, f"{flag} set but {key!r} still in record"

@@ -445,6 +445,36 @@ def test_trace_report_analyze_and_cli(tmp_path):
     assert rec["problems"] == [] and len(rec["requests"]) == 3
 
 
+def test_trace_report_counter_track_rollup(tmp_path):
+    """ISSUE 11 satellite: counter tracks roll up to n/min/mean/max/last
+    over the recorded CHANGE points (the tracer dedups repeats, so the
+    mean is over distinct recorded values, not time-weighted)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+
+    tr = Tracer()
+    for v in (3, 1, 8, 4):
+        tr.counter("queue_depth", v)
+    tr.counter("occupied_slots", 2)
+    path = tmp_path / "c.trace.json"
+    tr.export_trace(str(path))
+
+    rep = trace_report.analyze(load_trace(str(path)))
+    q = rep["counter_stats"]["queue_depth.value"]
+    assert q["n"] == 4
+    assert q["min"] == 1 and q["max"] == 8 and q["last"] == 4
+    assert q["mean"] == 4.0
+    o = rep["counter_stats"]["occupied_slots.value"]
+    assert o["n"] == 1 and o["last"] == 2
+    # the legacy last-value map stays for compat
+    assert rep["counters_last"]["queue_depth.value"] == 4
+    json.loads(json.dumps(rep, allow_nan=False))
+
+
 # ----------------------------------------------------------------------
 # bench harness smoke (slow: subprocess + fresh jax init); the fast legs
 # above cover the library — this pins the harness itself
