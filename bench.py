@@ -37,11 +37,14 @@ speculative parity cross token-identical across tp, a failover replay
 over disjoint tp groups — scripts/bench_tp_serving.py, skip with
 DTM_BENCH_SKIP_TP), and a ``train_census`` block (ROADMAP 5a: per-path
 pinned compile budgets for Trainer.fit()'s program family —
-scripts/bench_train_census.py, skip with DTM_BENCH_SKIP_TRAIN_CENSUS).
-The tp_serving, train_census, and serving-subprocess gates (compile
-census budgets, the ISSUE 11 telemetry <=2% overhead bar, SLO/goodput
-counter arithmetic) fail the bench run (exit 3) on breach, after the
-record prints.
+scripts/bench_train_census.py, skip with DTM_BENCH_SKIP_TRAIN_CENSUS),
+and a ``quant`` block (ISSUE 12: weight-only int8 decode — the
+greedy-parity gate over zoo LM configs x layouts vs full precision plus
+the d512 bytes-moved row — scripts/bench_decode.py --quant-only, skip
+with DTM_BENCH_SKIP_QUANT).  The tp_serving, train_census, quant, and
+serving-subprocess gates (compile census budgets, the ISSUE 11
+telemetry <=2% overhead bar, SLO/goodput counter arithmetic) fail the
+bench run (exit 3) on breach, after the record prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -420,6 +423,53 @@ def main() -> None:
             tp_gate_rc = 1
             print(f"bench: tp_serving phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 5d — quantized decode compute (ISSUE 12): weight-only int8
+    # matmuls with fused dequant, measured two ways by scripts/
+    # bench_decode.py --quant-only in a SUBPROCESS on the CPU backend:
+    # the greedy-parity gate (zoo LM configs x dense/paged x decode_ahead
+    # {1,8} x ±speculative vs full precision on briefly-fit weights;
+    # breach exits 4) and the d512 bytes-moved row (int8+scales weight
+    # stream vs f32 — the bandwidth claim emulated CPU can make
+    # honestly).  Skippable (DTM_BENCH_SKIP_QUANT); a parity breach
+    # FAILS the bench run (exit 3) after the record prints — quantization
+    # that changes tokens past the floor is a regression, not a knob.
+    quant = None
+    quant_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_QUANT"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_decode.py"),
+                 "--quant-only", "--reps", "3"],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "quant_decode":
+                    quant = rec
+            if quant is None or out.returncode != 0:
+                quant_gate_rc = out.returncode or 1
+                print(
+                    f"bench: quant subprocess "
+                    f"{'produced no record' if quant is None else 'FAILED (greedy-parity gate)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            quant_gate_rc = 1
+            print(f"bench: quant phase failed: {e!r}", file=sys.stderr)
+
     # Phase 6 — the chaos soak (ISSUE 3): seeded multi-fault plans against
     # training (torn checkpoint write, NaN step, checkpoint-read + data-
     # batch I/O faults -> bit-identical recovery) and serving (poisoned
@@ -684,6 +734,10 @@ def main() -> None:
         result["train_census"] = {
             k: v for k, v in train_census.items() if k != "metric"
         }
+    if quant is not None:
+        result["quant"] = {
+            k: v for k, v in quant.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -696,7 +750,7 @@ def main() -> None:
     # serving: compile budgets + telemetry overhead + SLO/goodput
     # arithmetic) fail the RUN, not just their block — after the record
     # prints so the numbers are never lost with the verdict
-    if tp_gate_rc or census_gate_rc or serving_gate_rc:
+    if tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc:
         import sys
 
         sys.exit(3)

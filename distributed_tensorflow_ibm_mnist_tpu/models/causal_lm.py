@@ -71,6 +71,13 @@ class CausalLM(nn.Module):
     #   output head (logits = x @ embed^T): V*dim fewer params, the
     #   standard small-LM regularizer.  The Megatron rule's feature-dim
     #   embedding sharding doubles as the head's row-parallel layout.
+    quant: str = "none"  # "int8": WEIGHT-only int8 matmuls (ISSUE 12) —
+    #   block projections and the untied logits head store int8 kernels +
+    #   per-output-channel f32 scales with dequant fused into the matmul
+    #   (models/quant.py).  Params must pass quantize_params_int8 (the
+    #   serving engine's upload/swap seams do).  Embedding stays full
+    #   precision (a gather, and the tied head shares it); orthogonal to
+    #   kv_cache_dtype (weights vs decode cache).
     moe_every: int = 0
     n_experts: int = 8
     moe_capacity_factor: float = 2.0
@@ -89,6 +96,17 @@ class CausalLM(nn.Module):
         b, s = tokens.shape
         if self.window < 0:
             raise ValueError(f"window must be >= 0, got {self.window}")
+        if self.quant not in ("none", "int8"):
+            raise ValueError(
+                f"quant must be 'none' or 'int8', got {self.quant!r}")
+        if self.quant != "none" and self.pp_stages > 0:
+            raise ValueError(
+                "quant composes with the plain block stack only: pp_stages "
+                "stacks params (n_stages, per_stage, ...) for the training "
+                "pipeline, which the int8 kernel/scale layout does not "
+                "cover — decode already unstacks pp weights (core/trainer."
+                "_decode_param_tree), so quantize the unstacked tree"
+            )
         if decode and self.pos == "learned":
             raise ValueError(
                 "decode mode needs position-free params: pos='learned' bakes "
@@ -174,12 +192,20 @@ class CausalLM(nn.Module):
                 moe_top_k=self.moe_top_k, moe_z_weight=self.moe_z_weight,
                 moe_fn=self.moe_fn, rope=rope, sow_kv=self.sow_kv,
                 window=self.window, kv_cache_dtype=self.kv_cache_dtype,
-                page_size=self.page_size,
+                page_size=self.page_size, quant=self.quant,
                 dtype=self.dtype, name=f"block_{i}",
             )(x, train, **extra)
         x = nn.LayerNorm(dtype=self.dtype, name="norm_out")(x)
         if self.tie_embeddings:
+            # the tied head reads the (full-precision) embedding table —
+            # quantizing it would also quantize the token lookup, so a
+            # quant model with tied embeddings keeps its head at full
+            # precision (documented in docs/PERFORMANCE.md)
             x = embed.attend(x)  # logits = x @ embed^T, weights shared
+        elif self.quant == "int8":
+            from distributed_tensorflow_ibm_mnist_tpu.models.quant import Int8Dense
+
+            x = Int8Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
         else:
             x = nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)
         return x.astype(jnp.float32)

@@ -141,6 +141,15 @@ def _attend_cached(q, kc, vc, ksc, vsc, mask, dtype):
     contraction — both D-times cheaper than dequantizing the cache, and
     the softmax sees exactly the dequantized scores.  GQA queries score a
     grouped einsum against the hkv-sized cache with no materialized repeat.
+
+    When the cache is int8, the scaled probabilities stay f32 INTO the PV
+    einsum (ISSUE 12 satellite / ADVICE.md): ``p * v_scale`` spans the
+    scale's dynamic range, so rounding it to bf16 BEFORE the contraction
+    compounded the int8 error for bf16 models — the einsum accumulates in
+    f32 anyway (``preferred_element_type``), and the int8 payload still
+    converts in-register (the HBM stream is unchanged), so keeping p at
+    f32 costs no cache bandwidth.  Native caches keep the compute-dtype p
+    (bit-identical to every previous round).
     """
     import jax
 
@@ -150,6 +159,7 @@ def _attend_cached(q, kc, vc, ksc, vsc, mask, dtype):
     scale = d ** -0.5
     kc_op = kc.astype(dtype) if quant else kc
     vc_op = vc.astype(dtype) if quant else vc
+    p_dtype = jnp.float32 if quant else dtype
     if hkv != h:
         qg = q.reshape(b, s, hkv, h // hkv, d)
         scores = jnp.einsum(
@@ -162,7 +172,7 @@ def _attend_cached(q, kc, vc, ksc, vsc, mask, dtype):
         if quant:
             p = p * vsc.transpose(0, 2, 1)[:, :, None, None, :]
         out = jnp.einsum(
-            "bhgqk,bkhd->bqhgd", p.astype(dtype), vc_op,
+            "bhgqk,bkhd->bqhgd", p.astype(p_dtype), vc_op,
             preferred_element_type=jnp.float32).reshape(b, s, h, d)
     else:
         scores = jnp.einsum(
@@ -175,7 +185,7 @@ def _attend_cached(q, kc, vc, ksc, vsc, mask, dtype):
         if quant:
             p = p * vsc.transpose(0, 2, 1)[:, :, None, :]
         out = jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(dtype), vc_op,
+            "bhqk,bkhd->bqhd", p.astype(p_dtype), vc_op,
             preferred_element_type=jnp.float32)
     return out.astype(dtype)
 
@@ -230,7 +240,27 @@ class TransformerBlock(nn.Module):
     #   (B, max_len/page_size) block table instead of a dense
     #   (B, max_len, ...) slab; see _paged_decode_attention.  The pool is
     #   engine state (serving/kv_pool.py), never initialized here.
+    quant: str = "none"  # "int8": WEIGHT-only quantization — every dense
+    #   projection in the block (qkv/q_proj/kv_proj/proj/dense_0/dense_1)
+    #   becomes an Int8Dense (models/quant.py): int8 kernel + per-output-
+    #   channel f32 scale, dequant fused into the matmul.  Params must be
+    #   transformed with quantize_params_int8 (the serving engine does
+    #   this at upload/swap); norms, embeddings, and MoE experts stay full
+    #   precision.  Orthogonal to kv_cache_dtype (weights vs cache).
     dtype: jnp.dtype = jnp.bfloat16
+
+    def _dense(self, features: int, name: str):
+        """The block's matmul layer: nn.Dense, or its int8-stored sibling
+        under the SAME name (so param trees transfer by name and the
+        Megatron TP rule's path matches are unchanged)."""
+        if self.quant == "int8":
+            from distributed_tensorflow_ibm_mnist_tpu.models.quant import Int8Dense
+
+            return Int8Dense(features, dtype=self.dtype, name=name)
+        if self.quant != "none":
+            raise ValueError(
+                f"quant must be 'none' or 'int8', got {self.quant!r}")
+        return nn.Dense(features, dtype=self.dtype, name=name)
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
@@ -241,7 +271,7 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="norm_attn")(x)
         hkv = self.heads_kv or self.heads
         if hkv == self.heads:
-            qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(h)
+            qkv = self._dense(3 * self.dim, "qkv")(h)
             qkv = qkv.reshape(b, s, 3, self.heads, head_dim)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
@@ -252,8 +282,8 @@ class TransformerBlock(nn.Module):
             # GQA: separate projections — q at full width, k/v at the
             # grouped width (the param saving IS the feature).  Named
             # q_proj/kv_proj for the Megatron TP rule.
-            q = nn.Dense(self.dim, dtype=self.dtype, name="q_proj")(h)
-            kv = nn.Dense(2 * hkv * head_dim, dtype=self.dtype, name="kv_proj")(h)
+            q = self._dense(self.dim, "q_proj")(h)
+            kv = self._dense(2 * hkv * head_dim, "kv_proj")(h)
             q = q.reshape(b, s, self.heads, head_dim)
             kv = kv.reshape(b, s, 2, hkv, head_dim)
             k, v = kv[:, :, 0], kv[:, :, 1]
@@ -268,7 +298,7 @@ class TransformerBlock(nn.Module):
                 self.sow("intermediates", "kv_cache", (k, v))
             o = _resolve_attn(self.attn_fn, self.attn)(q, k, v)
         o = o.reshape(b, s, self.dim)
-        o = nn.Dense(self.dim, dtype=self.dtype, name="proj")(o)
+        o = self._dense(self.dim, "proj")(o)
         if self.dropout > 0.0:
             o = nn.Dropout(self.dropout, deterministic=not train)(o)
         x = x + o
@@ -289,9 +319,9 @@ class TransformerBlock(nn.Module):
                 z_weight=self.moe_z_weight, ep_fn=self.moe_fn, name="moe",
             )(h, train=train)
         else:
-            h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype, name="dense_0")(h)
+            h = self._dense(self.mlp_ratio * self.dim, "dense_0")(h)
             h = nn.gelu(h)
-            h = nn.Dense(self.dim, dtype=self.dtype, name="dense_1")(h)
+            h = self._dense(self.dim, "dense_1")(h)
         if self.dropout > 0.0:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h

@@ -133,6 +133,24 @@ def megatron_rule(n_shards: int, axis: str = "model") -> SpecRule:
         if kind == "bias" and ndim == 1 and shape[0] % n_shards == 0:
             if name in ("qkv", "q_proj", "kv_proj") or re.fullmatch(r"fc\d*", name):
                 return P(axis)  # match the column-parallel output sharding
+        if kind == "scale" and ndim == 1:
+            # int8 weight-only quantization (models/quant.py): the
+            # per-OUTPUT-CHANNEL scale follows its kernel's output-feature
+            # sharding.  Column-parallel modules (qkv/q_proj/kv_proj/even
+            # dense_i/fc*) shard output features, so their scales shard
+            # P(axis); row-parallel modules (proj/odd dense_i/logits) keep
+            # output features whole per chip, so their scales REPLICATE —
+            # the per-channel factor is uniform over the contraction axis
+            # and distributes over the psum.  LayerNorm "scale" leaves
+            # land here too and stay replicated (their module names never
+            # match), identical to the pre-quant rule.
+            m = re.fullmatch(r"dense_(\d+)", name)
+            col = ((m is not None and int(m.group(1)) % 2 == 0)
+                   or name in ("qkv", "q_proj", "kv_proj")
+                   or re.fullmatch(r"fc\d*", name) is not None)
+            if col and shape[0] % n_shards == 0:
+                return P(axis)
+            return P()
         return P()
 
     return rule

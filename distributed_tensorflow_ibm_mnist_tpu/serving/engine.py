@@ -134,6 +134,7 @@ from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
     cache_shapes,
     make_prefill,
 )
+from distributed_tensorflow_ibm_mnist_tpu.models.quant import quantize_params_int8
 from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_slots
 from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
     kv_cache_rule,
@@ -249,6 +250,7 @@ class InferenceEngine:
                  kv_page_size: int = 0, kv_pages: int = 0,
                  radix_cache: bool | None = None,
                  tp: int = 1, tp_devices=None,
+                 quant: str | None = None,
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  rng=None, writer: MetricWriter | None = None,
@@ -354,6 +356,32 @@ class InferenceEngine:
             )
 
             _enable_compile_cache(compile_cache_dir)
+        # --- weight-only int8 quantization (ISSUE 12) --- the model
+        # clones to its Int8Dense form and the HOST param tree quantizes
+        # ONCE here (per-output-channel symmetric scales, models/quant.py)
+        # — BEFORE the tp mesh block below, so under tp=N the sharding
+        # specs are computed over the QUANTIZED tree and the scale leaves
+        # shard alongside the Megatron column/row splits (megatron_rule's
+        # "scale" rule).  swap_params re-runs the same transform, so a
+        # router hot-swap handing full-precision host checkpoints just
+        # works.  The whole downstream program family (per-bucket prefill,
+        # decode/verify windows, insert/reset, paged extend, prewarm) is
+        # quant-blind: quant lives in the model fields + the param tree,
+        # so the family stays one program per (site, shape-key).
+        if quant not in (None, "none", "int8"):
+            raise ValueError(
+                f"quant must be None/'none' or 'int8' (weight-only int8 "
+                f"matmuls with fused dequant), got {quant!r}")
+        self.quant = "int8" if quant == "int8" else "none"
+        if self.quant == "int8":
+            try:
+                model = model.clone(quant="int8")
+            except TypeError:
+                raise ValueError(
+                    f"quant='int8' needs a model with a quant= field "
+                    f"(the causal-LM family); {type(model).__name__} has "
+                    "none") from None
+            params = quantize_params_int8(params)
         # --- tensor-parallel mesh (tp=1: every attribute None, the whole
         # path byte-identical to the single-chip engine) --- the serving
         # half of ROADMAP item 5b: weights column/row-sharded by the SAME
@@ -677,7 +705,8 @@ class InferenceEngine:
         caller that swapped in a fresh ServingStats still reports them."""
         self.stats.memory(
             tp=self.tp, kv_bytes_per_chip=self.kv_bytes_per_chip(),
-            weight_bytes_per_chip=self.weight_bytes_per_chip())
+            weight_bytes_per_chip=self.weight_bytes_per_chip(),
+            quant=self.quant)
 
     def _dev(self, x):
         """Host upload for per-window device inputs.  Single-chip: a plain
@@ -1630,6 +1659,13 @@ class InferenceEngine:
                 f"pending={len(self._pending)}, queued={len(self.scheduler)})"
                 " — drain it first (stop submitting, pump step() until "
                 "has_work is False)")
+        if self.quant == "int8":
+            # the hot-swap contract hands FULL-PRECISION host trees (the
+            # router gives every replica the same checkpoint): re-quantize
+            # to the engine's int8+scale layout before placement.  A tree
+            # that already carries int8 kernels passes through unchanged
+            # (quantize_params_int8 is idempotent).
+            params = quantize_params_int8(params)
         if self._mesh is not None:
             # accepts a full host/single-chip tree and re-shards it
             # wholesale onto THIS engine's mesh (the router's hot-swap

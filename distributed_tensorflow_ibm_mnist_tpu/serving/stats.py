@@ -173,6 +173,8 @@ class ServingStats:
         self._tp = 1
         self._kv_bytes_per_chip: int | None = None
         self._weight_bytes_per_chip: int | None = None
+        self._quant = "none"  # weight storage scheme ("int8" when the
+        #   engine quantizes at upload — ISSUE 12); stamped with memory()
 
     def tick(self, occupied: int, dt: float, decoded: bool = False) -> None:
         self._occ_time += occupied * dt
@@ -234,14 +236,16 @@ class ServingStats:
             self._radix_misses += 1
 
     def memory(self, tp: int, kv_bytes_per_chip: int,
-               weight_bytes_per_chip: int) -> None:
-        """Stamp the engine's tensor-parallel degree and per-chip memory
+               weight_bytes_per_chip: int, quant: str = "none") -> None:
+        """Stamp the engine's tensor-parallel degree, per-chip memory
         footprint (parallel/tensor_parallel.per_chip_bytes over the cache
-        and the decode weights).  Re-stamped at every emit point, so a
-        stats object swapped in mid-run still reports them."""
+        and the decode weights), and weight storage scheme (``quant``).
+        Re-stamped at every emit point, so a stats object swapped in
+        mid-run still reports them."""
         self._tp = int(tp)
         self._kv_bytes_per_chip = int(kv_bytes_per_chip)
         self._weight_bytes_per_chip = int(weight_bytes_per_chip)
+        self._quant = str(quant)
 
     def set_compile(self, delta: dict) -> None:
         """Record the engine's compile accounting — a
@@ -378,6 +382,7 @@ class ServingStats:
             "tp": self._tp,
             "kv_bytes_per_chip": self._kv_bytes_per_chip,
             "weight_bytes_per_chip": self._weight_bytes_per_chip,
+            "quant": self._quant,
             # radix prefix sharing (partial-prefix prefill skips)
             "radix_hits": self._radix_hits,
             "radix_misses": self._radix_misses,
@@ -484,6 +489,7 @@ class ServingStats:
         # chip anywhere (max), the cluster total sums per_chip * tp per
         # engine, and `tp` reports the common degree or None when mixed
         tps = {rec._tp for rec in records}
+        quants = {rec._quant for rec in records}
         stamped = [rec for rec in records
                    if rec._kv_bytes_per_chip is not None]
         out = {
@@ -548,6 +554,9 @@ class ServingStats:
             "radix_hit_rate": (round(r_hits / (r_hits + r_miss), 4)
                                if (r_hits + r_miss) > 0 else None),
             "tp": tps.pop() if len(tps) == 1 else None,
+            # common scheme or None when replicas disagree (a mid-rollout
+            # mixed fleet is visible, never silently averaged)
+            "quant": quants.pop() if len(quants) == 1 else None,
             "kv_bytes_per_chip": (
                 max(rec._kv_bytes_per_chip for rec in stamped)
                 if stamped else None),

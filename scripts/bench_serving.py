@@ -359,6 +359,12 @@ CENSUS_BUDGET = {
     #                         cache-alloc/param-upload programs compile at
     #                         engine CONSTRUCTION, before this leg's delta
     "tp_repeat": 0,         # tp changes program CONTENTS, never counts
+    "quant_cold": 4,        # prefill + insert + window + reset with int8
+    #                         kernels inside — the dense cold set minus
+    #                         the pick/helper jits the earlier dense legs
+    #                         already warmed; quant must NOT fork the
+    #                         program family past these four sites
+    "quant_repeat": 0,      # the int8 tree must not flap jit cache keys
 }
 
 # Per-site pins for the speculative leg (ISSUE 9): the verify window is
@@ -388,11 +394,16 @@ def run_compile_census(slots: int) -> dict:
        decode window; ``slot_draft`` must compile NOTHING — per-site pins
        in ``SPEC_SITE_BUDGET``);
     8. spec_repeat: zero.
-    9. tp_cold (ISSUE 10, >= 2 devices): the same dense family under a
-       2-chip tp mesh — ONE program per (site, shape-key); GSPMD changes
-       program contents, never counts, and a site compiling twice means
-       the jit cache key is flapping on input shardings;
-    10. tp_repeat: zero again.
+    9. quant_cold (ISSUE 12): a fresh int8 weight-quant engine compiles
+       the SAME program set as the dense cold engine — the family is
+       quant-BLIND (int8 kernels/scales change what programs contain,
+       never how many there are);
+    10. quant_repeat: zero — the int8 tree must not flap jit cache keys.
+    11. tp_cold (ISSUE 10, >= 2 devices): the same dense family under a
+        2-chip tp mesh — ONE program per (site, shape-key); GSPMD changes
+        program contents, never counts, and a site compiling twice means
+        the jit cache key is flapping on input shardings;
+    12. tp_repeat: zero again.
     """
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
@@ -452,6 +463,19 @@ def run_compile_census(slots: int) -> dict:
                                 max_queue=8))
     legs["spec_cold"] = serve_one(seng, [rand_prompt(8)])
     legs["spec_repeat"] = serve_one(seng, [rand_prompt(10)])
+    # the quantized program family (ISSUE 12): a fresh int8 weight-quant
+    # engine must compile the SAME program set as the dense cold engine —
+    # quant lives in the model fields and the param tree (int8 kernels +
+    # scale leaves), so the family is quant-BLIND: same sites, same
+    # shape-keys, different dtypes inside.  A quant_cold count above the
+    # dense cold set means quantization forked a program family; any
+    # quant_repeat compile means the int8 tree flaps the jit cache key.
+    qeng = InferenceEngine(
+        model, params, slots=slots, max_len=max_len, quant="int8",
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(16, 32),
+                                max_queue=8))
+    legs["quant_cold"] = serve_one(qeng, [rand_prompt(8)])
+    legs["quant_repeat"] = serve_one(qeng, [rand_prompt(10)])
     # the tensor-parallel program family (ISSUE 10): the SAME engine
     # sharded over a 2-chip tp mesh must stay ONE program per (site,
     # shape-key) — GSPMD partitioning changes what each program contains,
@@ -498,6 +522,7 @@ def run_compile_census(slots: int) -> dict:
             and legs["bucket32_repeat"]["n_new_programs"] == 0
             and legs["paged_repeat"]["n_new_programs"] == 0
             and legs["spec_repeat"]["n_new_programs"] == 0
+            and legs["quant_repeat"]["n_new_programs"] == 0
             and legs.get("tp_repeat", {"n_new_programs": 0})[
                 "n_new_programs"] == 0),
         "new_bucket_compiles": legs["bucket32_new"]["n_new_programs"] > 0,

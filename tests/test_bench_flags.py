@@ -41,6 +41,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_ROUTER": ["router"],
     "DTM_BENCH_SKIP_SPEC": ["speculative"],
     "DTM_BENCH_SKIP_TRAIN_CENSUS": ["train_census"],
+    "DTM_BENCH_SKIP_QUANT": ["quant"],
 }
 
 

@@ -911,6 +911,7 @@ def test_on_mesh_int8_cache_decodes(eight_devices):
     ("gqa_window", {"heads_kv": 2, "window": 8}),
     ("moe", {"moe_every": 1, "n_experts": 2}),
     ("tied", {"tie_embeddings": True}),
+    ("int8_kv", {"kv_cache_dtype": "int8"}),
 ])
 def test_decode_params_cast_bit_exact(name, mk):
     """_decode_params' compute-dtype cast must be invisible (ADVICE.md r5):

@@ -1,0 +1,294 @@
+"""Weight-only int8 decode compute (ISSUE 12): quantization is invisible
+in the tokens and ~4x smaller in the weight stream.
+
+The decisive properties:
+
+* STRUCTURE — ``quantize_params_int8`` rewrites every block projection
+  and the untied logits head to int8 kernels + per-output-channel f32
+  scales, leaves embeddings/norms/biases untouched, and is IDEMPOTENT
+  (the engine calls it unconditionally at upload and swap).
+* NUMERICS — ``Int8Dense`` computes exactly ``(x @ q) * scale + bias``
+  with f32 accumulation; the end-to-end quant model's logits drift from
+  full precision by a bounded amount, and greedy serving agrees with
+  the full-precision engine above the pinned floor.
+* COMPOSITION — paged/dense, decode_ahead 1/8 and speculative/plain are
+  token-identical UNDER quant (the engine's program family is
+  quant-blind); ``swap_params`` re-quantizes a full-precision host
+  tree; ``prewarm()`` covers the quant family so serving compiles zero
+  programs.
+* SATELLITE 1 — with int8 KV quant on, attention probabilities stay f32
+  into the PV einsum even on a bf16 model (models/transformer.py
+  ``_attend_cached``); the teacher-forcing drift bound pins it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.models.quant import (
+    Int8Dense,
+    is_quantized,
+    quantize_kernel_int8,
+    quantize_params_int8,
+    weight_stream_bytes,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
+    InferenceEngine,
+)
+
+KW = dict(num_classes=16, dim=64, depth=2, heads=4, dtype=jnp.float32)
+
+MAX_LEN = 32
+# repetitive suffixes so the speculative case's n-gram drafter gets hits
+PROMPTS = [[1, 2, 3, 1, 2, 3, 1, 2], [4, 5, 4, 5, 4, 5], [6, 7, 8, 9],
+           [2, 4, 2, 4, 2, 4]]
+
+
+def _model_and_params(seed=0, **over):
+    model = get_model("causal_lm", **{**KW, **over})
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model, params, **ekw):
+    return InferenceEngine(
+        model, params, slots=2, max_len=MAX_LEN,
+        scheduler=FIFOScheduler(max_len=MAX_LEN, buckets=(16,),
+                                max_queue=len(PROMPTS)),
+        **ekw)
+
+
+def _serve(model, params, max_new=6, prompts=PROMPTS, **ekw):
+    eng = _engine(model, params, **ekw)
+    reqs = [eng.submit(p, max_new=max_new) for p in prompts]
+    eng.run()
+    outs = [list(r.generated) for r in reqs]
+    eng.close()
+    return outs
+
+
+@pytest.fixture(scope="module")
+def fp():
+    return _model_and_params()
+
+
+# ----------------------------------------------------------------------
+# structure: what quantizes, what doesn't, and idempotence
+
+
+def test_quantize_structure(fp):
+    _, params = fp
+    q = quantize_params_int8(params)
+    blk = q["block_0"]
+    # every projection: int8 kernel + per-output-channel f32 scale
+    for name, dim_out in (("qkv", 3 * KW["dim"]), ("proj", KW["dim"]),
+                          ("dense_0", 4 * KW["dim"]),
+                          ("dense_1", KW["dim"])):
+        assert blk[name]["kernel"].dtype == jnp.int8, name
+        assert blk[name]["scale"].shape == (dim_out,), name
+        assert blk[name]["scale"].dtype == jnp.float32, name
+        assert blk[name]["bias"].dtype == params["block_0"][name]["bias"].dtype
+    assert q["logits"]["kernel"].dtype == jnp.int8
+    # NOT quantized: embedding (a gather), norms (1-D "scale"/"bias")
+    assert q["embed"]["embedding"].dtype == jnp.float32
+    assert q["block_0"]["norm_attn"]["scale"].dtype == jnp.float32
+    assert is_quantized(q) and not is_quantized(params)
+
+
+def test_quantize_idempotent(fp):
+    _, params = fp
+    q1 = quantize_params_int8(params)
+    q2 = quantize_params_int8(q1)
+    flat1 = jax.tree_util.tree_leaves_with_path(q1)
+    flat2 = jax.tree_util.tree_leaves_with_path(q2)
+    assert [p for p, _ in flat1] == [p for p, _ in flat2]
+    for (_, a), (_, b) in zip(flat1, flat2):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quantize_kernel_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 48), jnp.float32)
+    q, scale = quantize_kernel_int8(w)
+    assert q.dtype == jnp.int8 and scale.shape == (48,)
+    assert int(jnp.max(jnp.abs(q.astype(jnp.int32)))) <= 127
+    # symmetric per-column: reconstruction error <= scale/2 elementwise
+    err = jnp.abs(q.astype(jnp.float32) * scale - w)
+    assert bool(jnp.all(err <= 0.5 * scale + 1e-7))
+
+
+def test_int8_dense_matches_manual_dequant():
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 16), jnp.float32)
+    bias = jax.random.normal(jax.random.PRNGKey(4), (16,), jnp.float32)
+    q, scale = quantize_kernel_int8(w)
+    layer = Int8Dense(16, dtype=jnp.float32)
+    got = layer.apply(
+        {"params": {"kernel": q, "scale": scale, "bias": bias}}, x)
+    want = (x @ (q.astype(jnp.float32))) * scale + bias
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_weight_stream_bytes_ratio(fp):
+    _, params = fp
+    q = quantize_params_int8(params)
+    ratio = weight_stream_bytes(params) / weight_stream_bytes(q)
+    # kernels go 4 -> 1 byte (+scales); embed/norms/biases stay f32, so
+    # the whole-tree ratio lands under 4x but well above 3x at this size
+    assert 3.2 <= ratio <= 4.0, ratio
+
+
+# ----------------------------------------------------------------------
+# numerics: drift bound and greedy agreement
+
+
+def test_quant_forward_logit_drift_bounded(fp):
+    model, params = fp
+    qmodel = model.clone(quant="int8")
+    qparams = quantize_params_int8(params)
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, 16, size=(2, 16)), jnp.int32)
+    ref = model.apply({"params": params}, tokens)
+    got = qmodel.apply({"params": qparams}, tokens)
+    drift = float(jnp.max(jnp.abs(ref - got)))
+    # measured 0.041 at this size/seed vs max |logit| 3.6; 0.15 is the
+    # regression ceiling, not the expectation
+    assert drift < 0.15, drift
+
+
+def test_engine_greedy_agreement_and_bytes(fp):
+    model, params = fp
+    ref = _serve(model, params)
+    eng = _engine(model, params, quant="int8")
+    assert is_quantized(eng_params_host(eng))
+    reqs = [eng.submit(p, max_new=6) for p in PROMPTS]
+    eng.run()
+    got = [list(r.generated) for r in reqs]
+    qbytes = eng.weight_bytes_per_chip()
+    assert eng.stats.summary()["quant"] == "int8"
+    eng.close()
+    total = sum(len(t) for t in ref)
+    agree = sum(a == b for rt, gt in zip(ref, got)
+                for a, b in zip(rt, gt))
+    assert agree / total >= 0.9, (agree, total)  # measured 24/24
+
+    feng = _engine(model, params)
+    fbytes = feng.weight_bytes_per_chip()
+    assert feng.stats.summary()["quant"] == "none"
+    feng.close()
+    assert 3.2 <= fbytes / qbytes <= 4.0, (fbytes, qbytes)
+
+
+def eng_params_host(eng):
+    return jax.tree.map(np.asarray, jax.device_get(eng.params))
+
+
+# ----------------------------------------------------------------------
+# composition: layout/window/spec invariance, swap, prewarm
+
+
+def test_quant_layout_invariance(fp):
+    """dense == paged == decode_ahead 8 == speculative, all WITH quant:
+    the program family is quant-blind, so every serving layout reads the
+    same int8 tree and says the same tokens."""
+    model, params = fp
+    base = _serve(model, params, quant="int8")
+    assert _serve(model, params, quant="int8", kv_page_size=8) == base
+    assert _serve(model, params, quant="int8", decode_ahead=8) == base
+    assert _serve(model, params, quant="int8", speculative="ngram",
+                  draft_len=3) == base
+
+
+def test_swap_params_requantizes(fp):
+    """swap_params with a full-precision HOST tree: the engine quantizes
+    at the seam, and serves token-identically to a fresh quant engine
+    built on those weights."""
+    model, params = fp
+    model2, params2 = _model_and_params(seed=3)
+    want2 = _serve(model2, params2, quant="int8")
+
+    eng = _engine(model, params, quant="int8")
+    host_tree = jax.tree.map(np.asarray, jax.device_get(params2))
+    eng.swap_params(host_tree)
+    assert eng.params["block_0"]["qkv"]["kernel"].dtype == jnp.int8
+    reqs = [eng.submit(p, max_new=6) for p in PROMPTS]
+    eng.run()
+    assert [list(r.generated) for r in reqs] == want2
+    eng.close()
+
+
+def test_quant_prewarm_zero_serving_compiles(fp):
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        CompileTracker,
+    )
+
+    model, params = fp
+    tracker = CompileTracker.install()
+    eng = _engine(model, params, quant="int8")
+    eng.prewarm()
+    before = tracker.snapshot()
+    reqs = [eng.submit(p, max_new=6) for p in PROMPTS]
+    eng.run()
+    d = CompileTracker.delta(tracker.snapshot(), before)
+    assert d["n_compiled_programs"] == 0, d["by_site"]
+    assert all(r.status == "done" for r in reqs)
+    eng.close()
+
+
+# ----------------------------------------------------------------------
+# rejections
+
+
+def test_engine_rejects_unknown_quant(fp):
+    model, params = fp
+    with pytest.raises(ValueError, match="quant"):
+        _engine(model, params, quant="int4")
+
+
+def test_model_rejects_quant_with_pp_stages():
+    model = get_model("causal_lm", **KW, quant="int8", pp_stages=2)
+    with pytest.raises(ValueError, match="pp_stages"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_model_rejects_unknown_quant_value():
+    model = get_model("causal_lm", **KW, quant="fp4")
+    with pytest.raises(ValueError, match="quant"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+# ----------------------------------------------------------------------
+# satellite 1: int8 KV on a bf16 model keeps the PV einsum's attention
+# probabilities in f32 (models/transformer._attend_cached p_dtype)
+
+
+def test_int8_kv_bf16_pv_probs_stay_f32_drift_bounded():
+    """Teacher-forcing decode on a BF16 model with kv_cache_dtype='int8'
+    vs the same model on the native cache: the f32-probability PV path
+    keeps the drift at the int8-quantization level (measured 0.032);
+    without it, bf16 probs stack a second rounding on top."""
+    model, params = _model_and_params(seed=14, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(0, 16, size=(2, 16)), jnp.int32)
+
+    def run(kv):
+        m = model.clone(kv_cache_dtype=kv)
+        logits, vars_ = m.apply({"params": params}, tokens[:, :8],
+                                decode=True, max_len=16, mutable=["cache"])
+        cache = vars_["cache"]
+        out = [logits]
+        for t in range(8, 16):
+            sl, vars_ = m.apply({"params": params, "cache": cache},
+                                tokens[:, t:t + 1], decode=True,
+                                max_len=16, mutable=["cache"])
+            cache = vars_["cache"]
+            out.append(sl)
+        return jnp.concatenate(out, axis=1)
+
+    drift = float(jnp.max(jnp.abs(run("native") - run("int8"))))
+    assert drift < 0.05, drift

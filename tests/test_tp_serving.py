@@ -270,6 +270,125 @@ def test_router_failover_over_disjoint_tp_groups(native, refs):
         assert summ["kv_bytes_cluster"] is not None
 
 
+# ----------------------------------------------------------------------
+# quant x tp (ISSUE 12 satellite): int8 weights shard like their f32
+# ancestors, scales ride the Megatron split, tokens never move
+
+
+@pytest.fixture(scope="module")
+def quant_ref(native):
+    return _serve(*native, tp=1, quant="int8")
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_quant_tp_parity_and_scale_layout(native, quant_ref, tp):
+    """quant engine at tp=N: token-identical to the tp=1 quant engine,
+    int8 kernels column/row-sharded, column scales P('tp') and row
+    scales replicated (per-output scale is uniform over the contraction
+    axis, so it distributes over the psum)."""
+    model, params = native
+    eng = _engine(model, params, tp=tp, quant="int8")
+    blk = eng.params["block_0"]
+    assert blk["qkv"]["kernel"].dtype == jnp.int8
+    assert "tp" in str(blk["qkv"]["scale"].sharding.spec)     # column
+    assert "tp" not in str(blk["proj"]["scale"].sharding.spec)  # row
+    reqs = [eng.submit(p, max_new=6) for p in PROMPTS]
+    eng.run()
+    assert [list(r.generated) for r in reqs] == quant_ref
+    eng.close()
+
+
+def test_quant_per_chip_weight_bytes(native):
+    """Per-chip weight bytes: ~4x smaller than f32 at tp=1 (kernels go
+    4 -> 1 byte; embed/norms/biases stay f32), and still ~1/tp under
+    the mesh — the int8 tree sharded like any other."""
+    model, params = native
+    sizes = {}
+    for tp in (1, 2, 4):
+        eng = _engine(model, params, tp=tp, quant="int8")
+        sizes[tp] = eng.weight_bytes_per_chip()
+        assert eng.stats.summary()["quant"] == "int8"
+        eng.close()
+    feng = _engine(model, params, tp=1)
+    full = feng.weight_bytes_per_chip()
+    feng.close()
+    assert 3.2 <= full / sizes[1] <= 4.0, (full, sizes[1])
+    for tp in (2, 4):
+        ratio = sizes[1] / sizes[tp]
+        # replicated embed/logits-scale tax is proportionally LARGER on
+        # the int8 tree, so the floor is looser than the f32 case
+        assert 0.45 * tp <= ratio <= 1.1 * tp, (tp, ratio)
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+def test_quant_swap_requantizes_and_reshards(native, tp):
+    """swap_params with a full-precision HOST tree at each tp: the
+    engine re-quantizes AND re-shards at the seam, pinned against a
+    fresh tp=1 quant engine on those weights."""
+    model, params = native
+    model2, params2 = _model_and_params(seed=3)
+    want2 = _serve(model2, params2, tp=1, quant="int8")
+
+    eng = _engine(model, params, tp=tp, quant="int8")
+    host_tree = jax.tree.map(np.asarray, jax.device_get(params2))
+    eng.swap_params(host_tree)
+    assert eng.params["block_0"]["qkv"]["kernel"].dtype == jnp.int8
+    if tp > 1:
+        assert "tp" in str(
+            eng.params["block_0"]["qkv"]["kernel"].sharding)
+    reqs = [eng.submit(p, max_new=6) for p in PROMPTS]
+    eng.run()
+    assert [list(r.generated) for r in reqs] == want2
+    eng.close()
+
+
+def test_chaos_event_counts_quant_invariant(native):
+    """quant changes the device programs' dtypes, never the host
+    control loop: admit/step event counts match the full-precision
+    engine exactly at tp 1 and 2."""
+    model, params = native
+    counts = {}
+    for quant in (None, "int8"):
+        for tp in (1, 2):
+            inj = FaultInjector(FaultPlan(faults=()))
+            eng = _engine(model, params, tp=tp, quant=quant, chaos=inj)
+            for p in PROMPTS:
+                eng.submit(p, max_new=6)
+            eng.run()
+            eng.close()
+            counts[(quant, tp)] = (inj.events("serving-admit"),
+                                   inj.events("serving-step"))
+    assert counts[(None, 1)] == counts[("int8", 1)] == counts[("int8", 2)]
+    assert counts[(None, 1)][0] >= len(PROMPTS)
+
+
+def test_router_failover_quant_token_identical(native, quant_ref):
+    """2 quant replicas over disjoint 2-chip tp groups; chaos kills one
+    mid-wave; the wave finishes on the quant reference tokens with
+    exactly one failover, and the rollup reports quant."""
+    model, params = native
+    groups = tp_device_groups(2, 2)
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="serving-step", kind="transient", at=(1,)),)))
+
+    def make_engine(tid, index):
+        return InferenceEngine(
+            model, params, slots=2, max_len=MAX_LEN, tp=2,
+            tp_devices=groups[index], quant="int8",
+            scheduler=FIFOScheduler(max_len=MAX_LEN, buckets=(16,),
+                                    max_queue=len(PROMPTS)),
+            trace_tid=tid, chaos=inj, stall_timeout_s=None)
+
+    with Router(make_engine, 2) as r:
+        rrs = [r.submit(p, max_new=6) for p in PROMPTS]
+        r.run_until_done()
+        assert [list(rr.generated) for rr in rrs] == quant_ref
+        assert r.failovers == 1
+        summ = r.summary()
+        assert summ["quant"] == "int8"
+        assert summ["tp"] == 2
+
+
 def test_tp_must_divide_heads_whole(native):
     model, params = native
     with pytest.raises(ValueError, match="divide"):
