@@ -41,10 +41,15 @@ scripts/bench_train_census.py, skip with DTM_BENCH_SKIP_TRAIN_CENSUS),
 and a ``quant`` block (ISSUE 12: weight-only int8 decode — the
 greedy-parity gate over zoo LM configs x layouts vs full precision plus
 the d512 bytes-moved row — scripts/bench_decode.py --quant-only, skip
-with DTM_BENCH_SKIP_QUANT).  The tp_serving, train_census, quant, and
-serving-subprocess gates (compile census budgets, the ISSUE 11
-telemetry <=2% overhead bar, SLO/goodput counter arithmetic) fail the
-bench run (exit 3) on breach, after the record prints.
+with DTM_BENCH_SKIP_QUANT), and a ``sampling`` block (ISSUE 13:
+per-request temperature/top_p/seed decode — the greedy-limit and
+seeded-replay token-identity gates plus the speculative
+rejection-sampling acceptance/throughput figures —
+scripts/bench_serving.py --sampling-only, skip with
+DTM_BENCH_SKIP_SAMPLING).  The tp_serving, train_census, quant,
+sampling, and serving-subprocess gates (compile census budgets, the
+ISSUE 11 telemetry <=2% overhead bar, SLO/goodput counter arithmetic)
+fail the bench run (exit 3) on breach, after the record prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -470,6 +475,55 @@ def main() -> None:
             quant_gate_rc = 1
             print(f"bench: quant phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 5e — per-request sampling (ISSUE 13): temperature/top_p/seed
+    # decode measured by scripts/bench_serving.py --sampling-only in a
+    # SUBPROCESS on the CPU backend: the greedy-limit gate (explicit
+    # temperature=0 params token-identical to plain greedy on dense AND
+    # speculative engines), the seeded-replay gate (the sampled stream
+    # served twice is token-identical — a request's tokens are a pure
+    # function of its seed), and the speculative rejection-sampling
+    # figures (acceptance rate + useful tokens/sec beside the greedy-spec
+    # floor).  Skippable (DTM_BENCH_SKIP_SAMPLING); a parity/replay gate
+    # breach FAILS the bench run (exit 3) after the record prints —
+    # sampling that leaks into greedy output or drifts across replays is
+    # a correctness regression, not noise.
+    sampling = None
+    sampling_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_SAMPLING"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_serving.py"),
+                 "--sampling-only"],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "sampling":
+                    sampling = rec
+            if sampling is None or out.returncode != 0:
+                sampling_gate_rc = out.returncode or 1
+                print(
+                    f"bench: sampling subprocess "
+                    f"{'produced no record' if sampling is None else 'FAILED (greedy-limit/replay gate)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            sampling_gate_rc = 1
+            print(f"bench: sampling phase failed: {e!r}", file=sys.stderr)
+
     # Phase 6 — the chaos soak (ISSUE 3): seeded multi-fault plans against
     # training (torn checkpoint write, NaN step, checkpoint-read + data-
     # batch I/O faults -> bit-identical recovery) and serving (poisoned
@@ -738,6 +792,10 @@ def main() -> None:
         result["quant"] = {
             k: v for k, v in quant.items() if k != "metric"
         }
+    if sampling is not None:
+        result["sampling"] = {
+            k: v for k, v in sampling.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -750,7 +808,8 @@ def main() -> None:
     # serving: compile budgets + telemetry overhead + SLO/goodput
     # arithmetic) fail the RUN, not just their block — after the record
     # prints so the numbers are never lost with the verdict
-    if tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc:
+    if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
+            or sampling_gate_rc):
         import sys
 
         sys.exit(3)

@@ -72,8 +72,22 @@ KV cursor is rewound to the acceptance point inside the same program;
 rejected positions hold garbage K/V that the NEXT window's k-token chunk
 overwrites before anything can attend it (decode attention writes before
 it gathers, and the causal mask never looks past a query's own position).
-Greedy-only by construction: argmax-vs-draft acceptance is exact for
-greedy decoding and would bias any sampled distribution.
+The PUBLIC ``make_verify_window`` verifies greedily: argmax-vs-draft
+acceptance is exact for greedy decoding and would bias any sampled
+distribution.
+
+ISSUE 13 adds the SAMPLING-aware siblings the serving engine composes:
+:func:`_pick_rows` (argmax / temperature / top-p selected by per-row
+*data* planes, never by program shape), :func:`_sample_window_core`
+(the decode-ahead scan with per-row fold-in PRNG keys and a position
+counter threaded through the carry, emitting per-token logprobs), and
+:func:`_verify_sample_core` (speculative REJECTION sampling: accept
+draft ``d`` with prob ``min(1, p_target(d)/q_draft(d))`` — ``p(d)`` for
+the point-mass n-gram drafter — and resample the residual on reject,
+which preserves the target distribution exactly; the ``temperature=0``
+rows reduce bit-for-bit to the argmax match).  One program serves every
+``(temperature, top_p, seed)`` mix, so distinct per-request configs
+never recompile.
 """
 
 from __future__ import annotations
@@ -450,6 +464,221 @@ def make_verify_window(model, max_len: int, draft_len: int,
                                    active, max_len, pad_id)
 
     return verify
+
+
+def _filter_topp_rows(logits, top_ps):
+    """Per-row nucleus filter with ``top_p`` as DATA — the plane-driven
+    sibling of :func:`_filter_logits`'s static ``top_p`` branch (same keep
+    rule: ranks whose PRECEDING mass is < p survive, so the argmax always
+    does).  ``top_ps`` is (B,) float32; rows with ``top_p <= 0`` or
+    ``>= 1`` pass through unfiltered, so greedy and unfiltered-sampling
+    rows ride the same program as nucleus rows."""
+    neg = jnp.finfo(logits.dtype).min
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = jnp.concatenate(
+        [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_ps[:, None]],
+        axis=-1)
+    cutoff = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    filtered = jnp.where(logits < cutoff, neg, logits)
+    nucleus = (top_ps > 0.0) & (top_ps < 1.0)
+    return jnp.where(nucleus[:, None], filtered, logits)
+
+
+def _tempered_rows(logits, temps, topps, top_k: int):
+    """The per-row SAMPLING distribution as filtered logits: temperature
+    scaling (before the filters, matching :func:`make_generator`'s static
+    order), optional static ``top_k``, then the data-driven nucleus
+    filter.  Rows with ``temps <= 0`` get a well-defined placeholder
+    (divide by 1) — their output is overridden by argmax in
+    :func:`_pick_rows`, the placeholder just keeps the math NaN-free."""
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)[:, None]
+    scaled = logits / safe_t
+    if top_k > 0:
+        scaled = _filter_logits(scaled, top_k, 0.0)
+    return _filter_topp_rows(scaled, topps)
+
+
+def _pick_rows(logits, temps, topps, keys, top_k: int = 0):
+    """Data-driven per-row pick: (B, V) logits + per-row ``temps`` /
+    ``topps`` / already-fold-in'd ``keys`` (B, 2) uint32 planes ->
+    ``((B,) int32 token, (B,) float32 logprob)``.  Rows with
+    ``temps <= 0`` take argmax (greedy) — selected by ``where`` on the
+    DATA, so every (temperature, top_p) mix shares one program.
+
+    The logprob is always ``log_softmax`` of the RAW logits at the
+    emitted token — the model's own distribution, before temperature or
+    nucleus reshaping — so best-of-n scores are comparable across
+    sampling configs and greedy requests report calibrated confidences.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = _tempered_rows(logits, temps, topps, top_k)
+    sampled = jax.vmap(
+        lambda l, k: jax.random.categorical(k, l))(filtered, keys)
+    tok = jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
+    logp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), tok[:, None], axis=1)[:, 0]
+    return tok, logp.astype(jnp.float32)
+
+
+def _sample_window_core(model, params, cache, tok, active, temps, topps,
+                        keys, pos, window: int, max_len: int, ragged: bool,
+                        top_k: int, pad_id: int):
+    """The sampling-aware decode-ahead window (ISSUE 13): ``window`` fused
+    decode+pick steps as ONE ``lax.scan``, with the per-row sampling
+    planes as runtime DATA and the PRNG threaded through the carry.
+
+    ``temps``/``topps`` are (B,) float32, ``keys`` (B, 2) uint32 BASE keys
+    (one per request, a pure function of its seed), ``pos`` (B,) int32 the
+    per-row count of already-generated tokens.  The token at generated
+    index ``n`` is picked with ``fold_in(base_key, n)``, and ``pos``
+    advances in the carry for active rows only — so a request's token
+    stream is a pure function of ``(seed, prefix)`` regardless of how the
+    host batches it into windows: decode_ahead k, engine restarts, and
+    router failover replays all land on the identical key schedule.
+    Returns ``(cache, (B, window) tokens, (B, window) logprobs, (B,) last,
+    (B,) new_pos)``; inactive rows emit ``pad_id`` / 0.0 logprob."""
+    active = jnp.asarray(active, bool)
+    pad = jnp.asarray(pad_id, jnp.int32)
+    temps = jnp.asarray(temps, jnp.float32)
+    topps = jnp.asarray(topps, jnp.float32)
+    keys = jnp.asarray(keys, jnp.uint32)
+    step = active.astype(jnp.int32)
+
+    def body(carry, _):
+        cache, tok, pos = carry
+        cache, logits = _decode_step_core(model, params, cache, tok,
+                                          max_len, ragged)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
+        nxt, logp = _pick_rows(logits, temps, topps, step_keys, top_k)
+        nxt = jnp.where(active, nxt, pad)
+        logp = jnp.where(active, logp, 0.0)
+        return (cache, nxt, pos + step), (nxt, logp)
+
+    (cache, last, pos), (toks, logps) = jax.lax.scan(
+        body, (cache, tok.astype(jnp.int32), jnp.asarray(pos, jnp.int32)),
+        None, length=window)
+    return cache, toks.T, logps.T, last, pos
+
+
+def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
+                        temps, topps, keys, pos, max_len: int, top_k: int,
+                        pad_id: int):
+    """Speculative verify with REJECTION SAMPLING (ISSUE 13) — the
+    sampling-aware sibling of :func:`_verify_window_core`, sharing its
+    one-forward / cursor-rewind mechanics and its (B, k) chunk contract.
+
+    Per draft lane ``j`` (draft ``d_j = chunk[:, j+1]``, target filtered
+    distribution ``p_j`` from the row's temperature/top-p planes): accept
+    with prob ``min(1, p_j(d_j) / q_j(d_j))`` — the n-gram drafter is a
+    point mass, ``q_j(d_j) = 1``, so the accept prob is ``p_j(d_j)``
+    against a uniform draw.  The first rejected lane emits a sample from
+    the RESIDUAL ``max(p_j - q_j, 0)`` renormalized (= ``p_j`` with
+    ``d_j`` masked out); a fully-accepted chunk emits the bonus token
+    sampled plain from the last position.  This is the standard
+    speculative-sampling identity: the emitted marginal equals sampling
+    ``p_j`` directly, at any draft quality, so PR 9's speedup extends to
+    sampled traffic without biasing the distribution (chi-squared gated
+    in tests/test_sampling.py).
+
+    PRNG discipline mirrors :func:`_sample_window_core`: the token at
+    generated index ``n`` owns base-fold ``K_n = fold_in(base, n)`` —
+    plain/bonus samples draw from ``K_n``, the accept uniform from
+    ``fold_in(K_n, 1)``, the residual resample from ``fold_in(K_n, 2)``,
+    so replays are token-identical and never reuse a draw.  Rows with
+    ``temps <= 0`` reduce via ``where`` to the EXACT argmax match of the
+    greedy core — same acceptances, same tokens, bit for bit.  Returns
+    ``(cache, (B, k) tokens, (B, k) logprobs, (B,) accepted, (B,) last)``
+    with logprobs from the raw-logits ``log_softmax`` like every pick.
+    """
+    chunk = chunk.astype(jnp.int32)
+    b, k = chunk.shape
+    dl = k - 1
+    active = jnp.asarray(active, bool)
+    draft_lens = jnp.asarray(draft_lens, jnp.int32)
+    pad = jnp.asarray(pad_id, jnp.int32)
+    temps = jnp.asarray(temps, jnp.float32)
+    topps = jnp.asarray(topps, jnp.float32)
+    keys = jnp.asarray(keys, jnp.uint32)
+    pos = jnp.asarray(pos, jnp.int32)
+    idx0 = _cache_cursor(cache)
+    if idx0 is None:
+        raise ValueError(
+            "cache pytree has no 'index' cursor leaf — not a decode cache")
+    idx0 = jnp.asarray(idx0, jnp.int32)
+    logits, vars_ = model.apply(
+        {"params": params, "cache": cache}, chunk,
+        decode=True, max_len=max_len, ragged=True, mutable=["cache"],
+    )
+    cache = vars_["cache"]
+    preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # (B, k)
+
+    # the per-position filtered target distribution, flattened to rows
+    flat = logits.reshape(b * k, -1)
+    filt = _tempered_rows(flat, jnp.repeat(temps, k),
+                          jnp.repeat(topps, k), top_k).reshape(b, k, -1)
+    probs = jax.nn.softmax(filt, axis=-1)                        # (B, k, V)
+
+    # generated index per position and its key family (flattened B*k)
+    posj = (pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :])
+    pick_key = jax.vmap(jax.random.fold_in)(
+        jnp.repeat(keys, k, axis=0), posj.reshape(-1))           # (B*k, 2)
+    u_key = jax.vmap(lambda kk: jax.random.fold_in(kk, 1))(pick_key)
+    res_key = jax.vmap(lambda kk: jax.random.fold_in(kk, 2))(pick_key)
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(
+        u_key).reshape(b, k)
+
+    # acceptance: sampled rows by rejection test, greedy rows by match
+    d = chunk[:, 1:]                                             # (B, dl)
+    p_draft = jnp.take_along_axis(
+        probs[:, :-1, :], d[..., None], axis=-1)[..., 0]         # (B, dl)
+    lanes = jnp.arange(dl, dtype=jnp.int32)[None, :]
+    valid = lanes < draft_lens[:, None]
+    accept = jnp.where(temps[:, None] > 0.0,
+                       u[:, :dl] < p_draft,
+                       preds[:, :-1] == d) & valid
+    acc = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+    acc = jnp.where(active, acc, 0)                              # (B,)
+
+    # candidate token at EVERY position: residual resample where a draft
+    # could have been rejected (lane < draft_lens), plain sample past the
+    # drafts (the bonus / short-draft continuation) — only position
+    # j == acc is ever emitted
+    neg = jnp.finfo(filt.dtype).min
+    vocab = filt.shape[-1]
+    res_logits = jnp.where(
+        jax.nn.one_hot(d, vocab, dtype=bool), neg, filt[:, :dl, :])
+    cand_res = jax.vmap(lambda l, kk: jax.random.categorical(kk, l))(
+        res_logits.reshape(b * dl, -1),
+        res_key.reshape(b, k, 2)[:, :dl].reshape(b * dl, 2),
+    ).reshape(b, dl).astype(jnp.int32)
+    cand_plain = jax.vmap(lambda l, kk: jax.random.categorical(kk, l))(
+        filt.reshape(b * k, -1), pick_key,
+    ).reshape(b, k).astype(jnp.int32)
+    jidx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    cand_res = jnp.concatenate(
+        [cand_res, jnp.full((b, 1), pad, jnp.int32)], axis=1)
+    cand = jnp.where(jidx < draft_lens[:, None], cand_res, cand_plain)
+    cand = jnp.where(temps[:, None] > 0.0, cand, preds)
+
+    drafts_pad = jnp.concatenate(
+        [d, jnp.full((b, 1), pad, jnp.int32)], axis=1)           # (B, k)
+    out = jnp.where(jidx < acc[:, None], drafts_pad,
+                    jnp.where(jidx == acc[:, None], cand, pad))
+    n_emit = jnp.where(active, acc + 1, 0)
+    emit = active[:, None] & (jidx < n_emit[:, None])
+    toks = jnp.where(emit, out, pad)
+    logps = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), toks[..., None],
+        axis=-1)[..., 0].astype(jnp.float32)
+    logps = jnp.where(emit, logps, 0.0)
+    last = jnp.take_along_axis(
+        toks, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    last = jnp.where(active, last, pad)
+    new_idx = jnp.minimum(idx0 + n_emit, max_len).astype(jnp.int32)
+    return _with_cursor(cache, new_idx), toks, logps, acc, last
 
 
 def init_cache(model, params, batch: int, max_len: int, shardings=None):

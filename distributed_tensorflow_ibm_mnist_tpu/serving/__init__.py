@@ -21,8 +21,15 @@ Mesh-TensorFlow separation of device program from execution driver
 * :class:`~.drafter.NgramDrafter` — model-free prompt-lookup drafting for
   speculative decoding (ISSUE 9, ``speculative="ngram"``): one verify
   forward accepts multiple host-drafted tokens per window with EXACT
-  greedy parity; ``InferenceEngine.prewarm()`` / ``Router.prewarm()``
+  greedy parity (rejection sampling for sampled rows — ISSUE 13);
+  ``InferenceEngine.prewarm()`` / ``Router.prewarm()``
   compile the full program family in the launch path (ROADMAP 5a)
+* :class:`~.sampling.SamplingParams` — per-request
+  ``(temperature, top_p, seed)`` sampling (ISSUE 13): per-slot data
+  planes into ONE compiled window program, position-keyed PRNG (a
+  request's stream is a pure function of its seed — restarts and
+  failover replays are token-identical), per-token raw-logits logprobs
+  on every :class:`~.scheduler.Request`
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
   slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
   compile accounting (``n_compiled_programs`` — ISSUE 6), emitted through
@@ -32,9 +39,9 @@ Mesh-TensorFlow separation of device program from execution driver
   :class:`~.router.WeightWatcher` — the multi-replica tier (ISSUE 8):
   least-loaded dispatch over N engine replicas, chaos-proven failover
   (``Request.engine_fault`` collateral re-dispatched to survivors,
-  exactly-once token delivery under greedy decode), and live weight hot
-  swap (drain → ``swap_params`` → re-admit, one replica at a time,
-  validated through ``restore_latest_intact``)
+  exactly-once token delivery for greedy AND seeded-sampled decode), and
+  live weight hot swap (drain → ``swap_params`` → re-admit, one replica
+  at a time, validated through ``restore_latest_intact``)
 
 Observability (ISSUE 6): pass ``tracer=`` (utils/tracing.Tracer) to the
 engine and every request records a span tree (submit → queue → admit/
@@ -70,6 +77,7 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.replica import Replica
+from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import SamplingParams
 from distributed_tensorflow_ibm_mnist_tpu.serving.router import (
     NoHealthyReplica,
     Router,
@@ -100,6 +108,7 @@ __all__ = [
     "Request",
     "Router",
     "RouterRequest",
+    "SamplingParams",
     "ServingStats",
     "WeightWatcher",
     "init_paged_cache",

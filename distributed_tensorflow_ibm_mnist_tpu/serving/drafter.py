@@ -14,6 +14,12 @@ A draft is only ever a PROPOSAL: the verify window
 (core/generate.py ``make_verify_window``) runs the target model over the
 drafted block and accepts exactly the prefix the model's own greedy argmax
 reproduces, so a bad draft costs wasted verify lanes, never a wrong token.
+Sampled rows (ISSUE 13) keep the same guarantee distributionally: the
+verify core accepts each drafted token by rejection sampling against the
+target's filtered distribution (accept with prob ``p_target(draft)``,
+resample from the draft-masked residual on reject), so the emitted stream
+is distributed exactly as plain sampling — the drafter itself never
+changes; it stays a model-free proposal source either way.
 On low-repetition (adversarial) text the match rate drops toward zero and
 speculative decoding degrades to plain decode — one emitted token per
 window — which is the honest floor documented in docs/PERFORMANCE.md.

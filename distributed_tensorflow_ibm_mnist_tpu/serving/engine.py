@@ -15,7 +15,7 @@ Device side (compiled once each, resident for the engine's lifetime):
   (one source of truth; an engine-level ``buckets=`` that disagrees with a
   caller-supplied scheduler is rejected at construction),
 * ONE batched decode-ahead WINDOW across all ``slots`` rows
-  (``_decode_window_core``: a ``lax.scan`` of ``decode_ahead`` fused
+  (``_sample_window_core``: a ``lax.scan`` of ``decode_ahead`` fused
   decode+pick steps, ragged — every slot owns an independent cursor),
 * a slot insert (``dynamic_update_slice`` of a prefilled row into the
   (slots, max_len) cache — the slot index is traced, so one compile) and a
@@ -40,16 +40,32 @@ its stop before the host sees it; those tokens are masked off the output
 (never appended, never delivered) and the row's ≤k−1 overrun writes land
 only in its own row (models/transformer.py clamps the cursor at max_len) —
 the same wasted-FLOPs-never-corruption contract idle slots already have.
-Greedy windows are token-identical for every k (a slot's tokens depend
-only on its own cache row and previous token); sampled runs stay
-self-deterministic per (rng, k) but consume keys in a k-dependent order.
+Windows are token-identical for every k — greedy because a slot's tokens
+depend only on its own cache row and previous token, sampled because the
+PRNG key for the token at generated index n is ``fold_in(base_key, n)``
+(serving/sampling.py): the index, not the window phase, owns the key, so
+decode-ahead width never changes a request's stream.
+
+Per-request sampling (ISSUE 13): a request may carry
+``SamplingParams(temperature, top_p, seed)`` (serving/sampling.py); the
+engine keeps per-slot (slots,) temperature/top-p planes and a (slots, 2)
+base-key plane as runtime DATA into ONE compiled window program
+(core/generate.py ``_sample_window_core``) — greedy and sampled rows ride
+the same program, so the compile census is invariant across sampling
+mixes.  Each generated token's raw-logits logprob comes back with the
+token block (``Request.logprobs``), and a request's stream is a pure
+function of its seed — restarts and failover replays are
+token-identical.
 
 Two more host-loop latencies hide behind the window (ISSUE 5):
 
 * **Prefix cache** (``prefix_cache_bytes=``, serving/prefix_cache.py) — a
   byte-bounded LRU keyed by blake2b over the (bucket, prompt) pair; a hit
-  reuses the stored prefill row + first token and skips the prefill
-  dispatch entirely.  Greedy-only by construction.
+  reuses the stored prefill row + last-position logits and skips the
+  prefill dispatch entirely.  Sampling-safe: the cache stores only the
+  DETERMINISTIC prefill products, and every admission (hit or miss) picks
+  its own first token from the logits with its own request's params
+  through the shared ``first_pick`` program (serving/sampling.py).
 * **Prefill overlap** — after dispatching a window and BEFORE blocking on
   its readback, the engine pops the next queued request and dispatches its
   bucketed B=1 prefill, so prefill compute overlaps the in-flight window
@@ -65,19 +81,27 @@ forwards.  Speculative mode replaces the window with its verify sibling
 drafts up to ``draft_len`` continuation tokens per slot with a model-free
 prompt-lookup drafter (serving/drafter.py — suffix n-gram match over the
 request's own prompt + generated stream), and ONE (slots, draft_len+1)-
-position target forward verifies the whole chunk, accepting per slot the
-longest drafted prefix the model's own greedy argmax reproduces plus one
-free correction token.  Every accepted lane is a sequential forward the
+position target forward verifies the whole chunk.  Greedy rows accept
+the longest drafted prefix the model's own argmax reproduces plus one
+free correction token — output is token-identical to plain greedy decode
+by construction (the emitted tokens ARE the argmax chain), pinned across
+dense/paged/int8 layouts in tests/test_speculative.py.  Sampled rows use
+speculative REJECTION sampling (core/generate.py ``_verify_sample_core``,
+ISSUE 13): draft token i is accepted with probability
+min(1, p_target(i)/q_draft(i)) and the first rejection resamples from
+the residual distribution, so the emitted marginal equals sampling the
+target directly (chi-squared gated in tests/test_sampling.py) and the
+stream stays a pure function of the request's seed at fixed engine
+config (replays are token-identical; the spec and plain sample PATHS
+differ — only their distributions and the greedy limit coincide).
+Every accepted lane is a sequential forward the
 engine didn't run; a rejected lane costs a wasted verify position, never
-a wrong token — output is token-identical to plain greedy decode by
-construction (the emitted tokens ARE the argmax chain), pinned across
-dense/paged/int8 layouts in tests/test_speculative.py.  The KV cursor is
-rewound in-graph to the acceptance point, so rejected positions are
-garbage the next window overwrites — the same
-wasted-FLOPs-never-corruption contract as decode-ahead overrun, on both
-layouts (paged allocation already budgets len+max_new; ISSUE 7).  Greedy
-only (``temperature=0``) and incompatible with sliding-window attention
-(both rejected at construction).  The chaos contract is unchanged: one
+a wrong token.  The KV cursor is rewound in-graph to the acceptance
+point, so rejected positions are garbage the next window overwrites —
+the same wasted-FLOPs-never-corruption contract as decode-ahead overrun,
+on both layouts (paged allocation already budgets len+max_new; ISSUE 7).
+Incompatible with sliding-window attention (rejected at construction).
+The chaos contract is unchanged: one
 ``serving-step`` event per window dispatch, whether that window decodes
 or verifies.  ``ServingStats`` gains drafted/accepted/corrected counters,
 ``accept_rate``, and ``useful_tokens_per_window``; each request's trace
@@ -127,9 +151,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
-    _decode_window_core,
-    _filter_logits,
-    _verify_window_core,
+    _sample_window_core,
+    _verify_sample_core,
     _zeros_like_shapes,
     cache_shapes,
     make_prefill,
@@ -156,6 +179,11 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
+from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import (
+    SamplingParams,
+    base_key,
+    first_pick,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import FIFOScheduler, Request
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import ServingStats
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
@@ -187,10 +215,12 @@ class InferenceEngine:
     ``speculative="ngram"`` swaps the decode window for the speculative
     verify window: a host-side prompt-lookup drafter proposes up to
     ``draft_len`` tokens per slot per window and one target forward
-    accepts the longest greedy-matching prefix + one correction token —
-    output stays token-identical to plain greedy decode; greedy-only,
-    and exclusive with sliding-window attention (see module docs).
-    ``prefix_cache_bytes`` arms the prompt prefix cache (greedy only).
+    accepts greedy rows by argmax match and sampled rows by rejection
+    sampling — greedy output stays token-identical to plain decode,
+    sampled output stays seed-deterministic and unbiased; exclusive with
+    sliding-window attention (see module docs).  ``prefix_cache_bytes``
+    arms the prompt prefix cache (sampling-safe — it stores prefill
+    logits, never a picked token).
 
     ``kv_page_size=ps`` switches the decode cache to the PAGED layout
     (serving/kv_pool.py): a fixed pool of ``kv_pages`` pages per layer plus
@@ -220,8 +250,14 @@ class InferenceEngine:
     (pinned in tests/test_tp_serving.py), and ``swap_params`` re-shards a
     full host tree onto the engine's own mesh.
 
-    Sampling knobs mirror ``make_generator`` (greedy at ``temperature=0``;
-    ``rng`` required otherwise — per-step keys are split from it).
+    Engine-level sampling knobs (``temperature``/``top_k``/``top_p``/
+    ``rng``) set the DEFAULT for requests that carry no
+    ``SamplingParams`` (greedy at ``temperature=0``; ``rng`` required
+    otherwise — its key data seeds the default base key).  A request's
+    own ``submit(..., sampling=SamplingParams(...))`` overrides the
+    default per slot; ``top_k`` stays an engine-level static knob (it
+    shapes the compiled filter), while temperature/top_p/seed are
+    per-slot runtime data.
     ``tracer=`` (utils/tracing.Tracer) records a span tree per request and
     per decode window (nil-guarded — zero tracing instructions when None);
     construct it with the same ``clock`` as the engine so span durations
@@ -282,11 +318,6 @@ class InferenceEngine:
                 raise ValueError(
                     f"draft_len must be >= 1 (tokens drafted per verify "
                     f"window), got {draft_len}")
-            if temperature != 0.0:
-                raise ValueError(
-                    "speculative decoding verifies drafts against the "
-                    "model's GREEDY argmax — exact for temperature == 0, "
-                    "biased for sampling; disable one")
             if getattr(model, "window", 0):
                 raise ValueError(
                     "speculative decoding does not compose with sliding-"
@@ -306,11 +337,6 @@ class InferenceEngine:
             raise ValueError(
                 f"prefix_cache_bytes must be >= 0 (0 disables the cache), "
                 f"got {prefix_cache_bytes}")
-        if prefix_cache_bytes > 0 and temperature != 0.0:
-            raise ValueError(
-                "the prefix cache replays a stored GREEDY first token — "
-                "wiring it to a sampling engine (temperature > 0) would "
-                "silently freeze what should be a fresh sample; disable one")
         if kv_page_size < 0 or kv_pages < 0:
             raise ValueError(
                 f"kv_page_size/kv_pages must be >= 0 (0 = dense layout), "
@@ -540,41 +566,41 @@ class InferenceEngine:
             lambda cache, mask: _pin(_reset_fn(cache, mask)),
             donate_argnums=(0,))
 
-        def _pick(logits, rng):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = _filter_logits(logits / temperature, top_k, top_p)
-            return jax.random.categorical(rng, logits).astype(jnp.int32)
-
         pad_id_ = self.pad_id
+        top_k_ = int(top_k)
+        window_ = self.decode_ahead
 
-        def _window_impl(params, cache, tok, active, rngs):
+        def _window_impl(params, cache, tok, active, temps, topps, keys,
+                         pos):
             # decode_ahead fused decode+pick steps as ONE dispatch
-            # (core/generate.py _decode_window_core): the host loop pays
+            # (core/generate.py _sample_window_core): the host loop pays
             # per-iteration dispatch latency and ONE blocking readback per
-            # WINDOW instead of per token — at decode_ahead=1 this is
-            # exactly the old fused step+pick (a scan of length 1), so the
-            # classic loop and the windowed loop are the same program
-            # family, not two code paths that can drift
-            cache, blk, last = _decode_window_core(
-                decode_model, params, cache, tok, active, rngs, max_len,
-                True, _pick, pad_id_)
-            return _pin(cache), blk, last
+            # WINDOW instead of per token.  temperature/top_p/base-key/
+            # position ride as per-slot DATA planes, so every sampling mix
+            # (greedy included) is this ONE program — the census never
+            # moves across distinct (temperature, top_p, seed) configs.
+            cache, blk, logps, last, pos = _sample_window_core(
+                decode_model, params, cache, tok, active, temps, topps,
+                keys, pos, window_, max_len, True, top_k_, pad_id_)
+            return _pin(cache), blk, logps, last, pos
 
         self._window = jax.jit(_window_impl, donate_argnums=(1,))
 
         if speculative is not None:
             # the speculative sibling: ONE (slots, draft_len+1)-position
             # target forward that verifies a host-drafted chunk, computes
-            # per-slot acceptance in-graph, and rewinds the KV cursor to
-            # the acceptance point (core/generate.py _verify_window_core).
-            # In spec mode this REPLACES the decode-ahead scan as the
-            # per-window dispatch: drafting happens on the host between
-            # windows, which a fused k-step scan could never pause for.
-            def _verify_impl(params, cache, chunk, draft_lens, active):
-                cache, *rest = _verify_window_core(
+            # per-slot acceptance in-graph (argmax match for greedy rows,
+            # rejection sampling for sampled rows), and rewinds the KV
+            # cursor to the acceptance point (core/generate.py
+            # _verify_sample_core).  In spec mode this REPLACES the
+            # decode-ahead scan as the per-window dispatch: drafting
+            # happens on the host between windows, which a fused k-step
+            # scan could never pause for.
+            def _verify_impl(params, cache, chunk, draft_lens, active,
+                             temps, topps, keys, pos):
+                cache, *rest = _verify_sample_core(
                     decode_model, params, cache, chunk, draft_lens, active,
-                    max_len, pad_id_)
+                    temps, topps, keys, pos, max_len, top_k_, pad_id_)
                 return (_pin(cache), *rest)
 
             self._verify = jax.jit(_verify_impl, donate_argnums=(1,))
@@ -584,33 +610,46 @@ class InferenceEngine:
         if kv_page_size:
             # partial-prefix prefill: compute only the unshared suffix of a
             # radix-matched prompt as one decode-mode chunk over the slot's
-            # block table, and pick its first token in-graph
+            # block table; the first-token pick runs separately through the
+            # shared first_pick program (one pick program for every
+            # landing path — miss, prefix hit, radix extend)
             _extend_impl = make_paged_extend(decode_model, max_len,
                                              kv_page_size)
 
-            def _extend_and_pick(params, cache, slot, bt_row, suffix,
-                                 start, suffix_len, rng):
+            def _extend_row(params, cache, slot, bt_row, suffix,
+                            start, suffix_len):
                 cache, last = _extend_impl(params, cache, slot, bt_row,
                                            suffix, start, suffix_len)
-                return _pin(cache), _pick(last, rng)
+                return _pin(cache), last
 
-            self._extend = jax.jit(_extend_and_pick, donate_argnums=(1,))
+            self._extend = jax.jit(_extend_row, donate_argnums=(1,))
 
-        def _prefill_and_pick(params, prompt, lens, rng):
+        def _prefill_row(params, prompt, lens):
             # the B=1 row cache is pinned head-sharded too: the insert
             # program's row input then always arrives in ONE layout,
             # whether it came from a fresh prefill, the prefix cache, or
-            # prewarm's zero row
+            # prewarm's zero row.  Returns the (1, V) last-position logits
+            # UNPICKED — the prefix cache stores them (never a sampled
+            # token) and every admission picks through first_pick.
             cache, last = self._prefill(params, prompt, lens)
-            return _pin(cache), _pick(last, rng)
+            return _pin(cache), last
 
-        self._prefill_and_pick = jax.jit(_prefill_and_pick)
-        self._greedy = temperature == 0.0
-        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
-        # greedy windows never read their keys: reuse ONE broadcast key
-        # block forever instead of dispatching a split per window
-        self._greedy_rngs = jnp.broadcast_to(
-            self._rng, (self.decode_ahead,) + self._rng.shape)
+        self._prefill_row = jax.jit(_prefill_row)
+        # per-request sampling defaults: the engine-level knobs cover every
+        # request submitted without SamplingParams.  The default base key
+        # comes from the rng= knob's key data (host bytes — greedy engines
+        # never touch it).
+        self._default_temp = float(temperature)
+        self._default_topp = float(top_p)
+        self._top_k = top_k_
+        if rng is None:
+            self._default_key = base_key(0)
+        else:
+            try:
+                kd = jax.random.key_data(rng)
+            except TypeError:
+                kd = rng
+            self._default_key = np.asarray(kd, np.uint32).reshape(-1)[-2:]
 
         # --- mutable engine state ---
         # cache zeros materialize DIRECTLY in their final layout: under tp
@@ -647,7 +686,21 @@ class InferenceEngine:
         self._slot_tok = np.full((slots,), self.pad_id, np.int32)
         self._tok_dev = None  # device copy of _slot_tok; None = stale
         self._active_dev = None  # device (slots,) bool mask; None = stale
-        # prefill-overlap parking lot: (req, (row_cache, first_tok, hit))
+        # per-slot sampling planes (host mirrors): temperature/top-p as
+        # (slots,) float32, the Threefry base key as (slots, 2) uint32.
+        # Uploaded once per occupancy change (_planes_dev, invalidated at
+        # admission like _tok_dev/_active_dev — a retired slot's stale
+        # plane rows are masked by `active`, so no invalidation there).
+        self._slot_temp = np.full((slots,), self._default_temp, np.float32)
+        self._slot_topp = np.full((slots,), self._default_topp, np.float32)
+        self._slot_key = np.tile(self._default_key, (slots, 1))
+        self._planes_dev = None  # (temps, topps, keys) on device; None = stale
+        # device (slots,) int32 count of already-generated tokens per slot
+        # — the PRNG position plane.  Plain windows return the advanced
+        # plane (carried like _tok_dev); spec windows re-upload fresh each
+        # dispatch (acceptance makes the advance data-dependent).
+        self._pos_dev = None
+        # prefill-overlap parking lot: (req, (row_cache, logits, hit))
         # tuples prefilled against an in-flight window, awaiting a slot
         self._pending: deque[tuple] = deque()
         # ids of parked requests whose landing STALLED on a dry page pool
@@ -778,7 +831,8 @@ class InferenceEngine:
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
                callback: Callable | None = None,
                ttft_slo_s: float | None = None,
-               tpot_slo_s: float | None = None) -> Request:
+               tpot_slo_s: float | None = None,
+               sampling: SamplingParams | None = None) -> Request:
         """Enqueue a request (see :meth:`FIFOScheduler.submit` for the
         admission rules; raises ``QueueFull`` under backpressure).
         ``callback(request, token)`` streams every generated token; if it
@@ -786,7 +840,10 @@ class InferenceEngine:
         engine keeps serving the rest.  ``ttft_slo_s``/``tpot_slo_s``
         declare latency SLO targets the engine judges at first token and
         retirement (never cancels — accounting only; serving/stats.py).
-        Refused after :meth:`drain` / :meth:`close`."""
+        ``sampling`` is the per-request :class:`SamplingParams`
+        (temperature/top_p/seed; None = the engine's construction
+        defaults) — the request's token stream is a pure function of its
+        seed.  Refused after :meth:`drain` / :meth:`close`."""
         if self._closed or self._draining:
             raise RuntimeError(
                 "engine is " + ("closed" if self._closed else "draining")
@@ -794,7 +851,8 @@ class InferenceEngine:
         return self.scheduler.submit(prompt, max_new, deadline_s=deadline_s,
                                      callback=callback,
                                      ttft_slo_s=ttft_slo_s,
-                                     tpot_slo_s=tpot_slo_s)
+                                     tpot_slo_s=tpot_slo_s,
+                                     sampling=sampling)
 
     @property
     def occupied(self) -> int:
@@ -805,22 +863,30 @@ class InferenceEngine:
         return (self.occupied > 0 or len(self.scheduler) > 0
                 or len(self._pending) > 0)
 
-    def _next_rng(self):
-        # greedy decode never reads the key — skip the split's dispatch
-        # (one per decode step; real latency on the host loop's hot path)
-        if self._greedy:
-            return self._rng
-        self._rng, key = jax.random.split(self._rng)
-        return key
+    def _req_sampling(self, req: Request):
+        """``(temperature, top_p, base_key)`` resolved for ``req`` — its
+        own :class:`SamplingParams`, or the engine's construction-time
+        defaults for requests submitted without one."""
+        s = req.sampling
+        if s is None:
+            return self._default_temp, self._default_topp, self._default_key
+        return float(s.temperature), float(s.top_p), s.key()
 
-    def _window_rngs(self):
-        """(decode_ahead, ...) per-step keys for one window — the cached
-        broadcast block for greedy (never read), a fresh split otherwise."""
-        if self._greedy:
-            return self._greedy_rngs
-        keys = jax.random.split(self._rng, self.decode_ahead + 1)
-        self._rng = keys[0]
-        return keys[1:]
+    def _first_pick(self, req: Request, logits):
+        """Pick ``req``'s FIRST token (generated index 0) from the
+        prefill's (1, V) last-position logits through the module-level
+        shared ``first_pick`` program (serving/sampling.py) — the same
+        program for a fresh prefill, a prefix-cache hit, and a paged
+        radix-extend landing, so hit/miss first tokens are bit-identical.
+        Returns ``(token, logprob)`` as host scalars."""
+        temp, topp, key = self._req_sampling(req)
+        with self._compile.site("first_pick"):
+            tok, logp = first_pick(
+                logits, self._dev(np.array([temp], np.float32)),
+                self._dev(np.array([topp], np.float32)),
+                self._dev(key[None, :].astype(np.uint32)),
+                self._dev(np.zeros((1,), np.int32)), top_k=self._top_k)
+        return int(tok[0]), float(logp[0])
 
     # ------------------------------------------------------------------
     # tracing bookkeeping (every helper is a no-op without a tracer —
@@ -914,7 +980,7 @@ class InferenceEngine:
     def _prefill_request(self, req: Request):
         """The per-request half of admission: one ``serving-admit`` chaos
         event, a prefix-cache lookup, and (on a miss) the bucketed B=1
-        prefill dispatch.  Returns ``(row_cache, first_token, cache_hit)``;
+        prefill dispatch.  Returns ``(row_cache, logits, cache_hit)``;
         exceptions are the REQUEST's failure and propagate to the caller
         (inline admit or overlap dispatch), which fails it in isolation.
         The chaos event fires once per admission attempt, hit or miss, so
@@ -939,9 +1005,12 @@ class InferenceEngine:
         return (*self._dense_prefill(req), False)
 
     def _dense_prefill(self, req: Request):
-        """The bucketed B=1 prefill dispatch (+ first-token pick) — the
-        dense tail of :meth:`_prefill_request`, also the paged landing's
-        fallback when a parked radix match was evicted before landing."""
+        """The bucketed B=1 prefill dispatch — the dense tail of
+        :meth:`_prefill_request`, also the paged landing's fallback when a
+        parked radix match was evicted before landing.  Returns
+        ``(row_cache, logits)``: the first-token pick happens at LANDING
+        through the shared ``first_pick`` program, never here — the
+        logits are the deterministic product the prefix cache may store."""
         padded = np.full((1, req.bucket), self.pad_id, np.int32)
         padded[0, : req.tokens.size] = req.tokens
         span = (self._tracer.begin("prefill", cat="serving",
@@ -950,13 +1019,13 @@ class InferenceEngine:
                 if self._tracer is not None and req.trace is not None else None)
         try:
             with self._compile.site(f"prefill[b{req.bucket}]"):
-                row_cache, first_tok = self._prefill_and_pick(
+                row_cache, logits = self._prefill_row(
                     self.params, jnp.asarray(padded),
-                    jnp.asarray([req.tokens.size], jnp.int32), self._next_rng())
+                    jnp.asarray([req.tokens.size], jnp.int32))
         finally:
             if span is not None:
                 self._tracer.end(span)  # a poisoned prefill still closes it
-        return row_cache, first_tok
+        return row_cache, logits
 
     def _usable_radix_tokens(self, req: Request, matched: int | None = None
                              ) -> int:
@@ -1006,9 +1075,10 @@ class InferenceEngine:
         span, install the block table, and either scatter the dense prefill
         row (full prefill / prefix-cache hit) or run the suffix-extend
         program over the radix-shared prefix.  Returns ``(first_token,
-        cache_hit)`` or None when the pool cannot cover the request right
-        now (the caller re-parks it — admission stall, not failure)."""
-        row_cache, first_tok, cache_hit = prefilled
+        first_logprob, cache_hit)`` or None when the pool cannot cover the
+        request right now (the caller re-parks it — admission stall, not
+        failure)."""
+        row_cache, logits, cache_hit = prefilled
         ps = self._page_size
         n_tok = int(req.tokens.size)
         path: list = []
@@ -1023,7 +1093,7 @@ class InferenceEngine:
                 # evaporated: plain dense prefill, WITHOUT re-firing the
                 # serving-admit chaos event (it fired at _prefill_request —
                 # one event per admission attempt, paging-invariant)
-                row_cache, first_tok = self._dense_prefill(req)
+                row_cache, logits = self._dense_prefill(req)
                 m_tok = 0
         m_blocks = len(path)
         if m_blocks:
@@ -1051,12 +1121,12 @@ class InferenceEngine:
             padded = np.full((1, sb), self.pad_id, np.int32)
             padded[0, : suffix.size] = suffix
             with self._compile.site(f"extend[b{sb}]"):
-                self.cache, first_dev = self._extend(
+                self.cache, ext_logits = self._extend(
                     self.params, self.cache, jnp.asarray(slot, jnp.int32),
                     bt_dev, jnp.asarray(padded),
                     jnp.asarray(m_tok, jnp.int32),
-                    jnp.asarray(suffix.size, jnp.int32), self._next_rng())
-            first = int(first_dev[0])
+                    jnp.asarray(suffix.size, jnp.int32))
+            first, first_logp = self._first_pick(req, ext_logits)
             self.stats.radix(True, tokens=m_tok)
             self._radix.record(True, tokens=m_tok)
             req.radix_tokens = m_tok
@@ -1065,13 +1135,14 @@ class InferenceEngine:
             with self._compile.site("slot_insert"):
                 self.cache = self._insert(self.cache, row_cache, bt_dev,
                                           jnp.asarray(slot, jnp.int32))
-            first = (first_tok if isinstance(first_tok, int)
-                     else int(first_tok[0]))
+            first, first_logp = self._first_pick(req, logits)
             if self._radix is not None:
                 self.stats.radix(False)
                 self._radix.record(False)
             if self._prefix is not None and not cache_hit:
-                self._prefix.put(req.prefix_key, row_cache, first)
+                # store the DETERMINISTIC prefill products only (row +
+                # logits), never the picked token — sampling safety
+                self._prefix.put(req.prefix_key, row_cache, logits)
         req.pages = total
         if self._radix is not None:
             # donate the freshly computed FULL prompt blocks below the
@@ -1086,7 +1157,7 @@ class InferenceEngine:
                 for node in held:
                     priv.remove(node.page)
                     nodes.append(node)
-        return first, cache_hit
+        return first, first_logp, cache_hit
 
     def _admit(self, req: Request, slot: int, now: float,
                prefilled: tuple | None = None) -> bool:
@@ -1118,23 +1189,25 @@ class InferenceEngine:
                     # re-parks the (already chaos'd, maybe prefilled)
                     # request and retries once decode frees pages
                     return ("stall", prefilled)
-                first, cache_hit = landed
+                first, first_logp, cache_hit = landed
                 inserted = True
             else:
-                row_cache, first_tok, cache_hit = prefilled
+                row_cache, logits, cache_hit = prefilled
                 with self._compile.site("slot_insert"):
                     self.cache = self._insert(
                         self.cache, row_cache, jnp.asarray(slot, jnp.int32))
                 inserted = True
-                # a cache hit stored the host int; a fresh prefill syncs here
-                first = (first_tok if isinstance(first_tok, int)
-                         else int(first_tok[0]))
+                # hit or miss, the pick runs HERE, per request, through the
+                # one shared first_pick program — what makes the prefix
+                # cache sampling-safe (it stores logits, never a token)
+                first, first_logp = self._first_pick(req, logits)
                 if self._prefix is not None and not cache_hit:
                     # insert does not donate row_cache, so the row stays
                     # valid to replay for every later identical prompt
-                    self._prefix.put(req.prefix_key, row_cache, first)
+                    self._prefix.put(req.prefix_key, row_cache, logits)
             req.admit_t = now
             req.generated.append(first)
+            req.logprobs.append(first_logp)
             req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
             # first token = progress: stamp the heartbeat here too, so an
             # engine killed later in this same step (before the end-of-step
@@ -1164,8 +1237,14 @@ class InferenceEngine:
             return inserted
         self._slot_req[slot] = req
         self._slot_tok[slot] = first
+        temp, topp, key = self._req_sampling(req)
+        self._slot_temp[slot] = temp
+        self._slot_topp[slot] = topp
+        self._slot_key[slot] = key
         self._tok_dev = None  # host mirror changed; re-upload before decode
         self._active_dev = None
+        self._planes_dev = None  # sampling planes changed with the slot
+        self._pos_dev = None  # rebuilt from host generated counts
         self._tr_phase(req, "decode", slot=slot)
         if self._done_reason(req) is not None:
             self._retire(slot, self._done_reason(req), self.clock())
@@ -1330,23 +1409,46 @@ class InferenceEngine:
                     with self._compile.site("slot_draft"):
                         chunk_dev = self._dev(chunk)
                         dls_dev = self._dev(dls)
+                        # acceptance makes the PRNG position advance
+                        # data-dependent: spec windows re-upload the plane
+                        # fresh from the host generated counts each window
+                        pos_dev = self._dev(np.array(
+                            [0 if r is None else len(r.generated)
+                             for r in self._slot_req], np.int32))
                     t_d1 = self.clock()
-                elif self._tok_dev is None:
-                    self._tok_dev = self._dev(self._slot_tok)
+                else:
+                    if self._tok_dev is None:
+                        self._tok_dev = self._dev(self._slot_tok)
+                    if self._pos_dev is None:
+                        # PRNG positions = tokens generated so far; the
+                        # window returns the advanced plane (carried like
+                        # _tok_dev, rebuilt here after any admission)
+                        self._pos_dev = self._dev(np.array(
+                            [0 if r is None else len(r.generated)
+                             for r in self._slot_req], np.int32))
                 if self._active_dev is None:
                     self._active_dev = self._dev(
                         np.array([r is not None for r in self._slot_req]))
+                if self._planes_dev is None:
+                    self._planes_dev = (self._dev(self._slot_temp),
+                                        self._dev(self._slot_topp),
+                                        self._dev(self._slot_key))
+                temps_dev, topps_dev, keys_dev = self._planes_dev
                 t_disp = self.clock()
                 if spec:
                     with self._compile.site(f"verify_window[k{k}]"):
-                        self.cache, blk_dev, acc_dev, _ = self._verify(
-                            self.params, self.cache, chunk_dev, dls_dev,
-                            self._active_dev)
+                        self.cache, blk_dev, logp_dev, acc_dev, _ = \
+                            self._verify(
+                                self.params, self.cache, chunk_dev, dls_dev,
+                                self._active_dev, temps_dev, topps_dev,
+                                keys_dev, pos_dev)
                 else:
                     with self._compile.site(f"decode_window[k{k}]"):
-                        self.cache, blk_dev, last_dev = self._window(
-                            self.params, self.cache, self._tok_dev,
-                            self._active_dev, self._window_rngs())
+                        self.cache, blk_dev, logp_dev, last_dev, pos_out = \
+                            self._window(
+                                self.params, self.cache, self._tok_dev,
+                                self._active_dev, temps_dev, topps_dev,
+                                keys_dev, self._pos_dev)
                 dispatch_s = self.clock() - t_disp
             except Exception as e:
                 now = self.clock()
@@ -1389,6 +1491,7 @@ class InferenceEngine:
                 # carry token) feeds the next window without a host slice
                 t_rb = self.clock()
                 blk = np.asarray(blk_dev)
+                logps = np.asarray(logp_dev)
                 acc = np.asarray(acc_dev) if spec else None
                 readback_s = self.clock() - t_rb
                 if spec:
@@ -1398,6 +1501,7 @@ class InferenceEngine:
                     self._tok_dev = None
                 else:
                     self._tok_dev = last_dev
+                    self._pos_dev = pos_out  # advanced in-graph, carried
                     self._slot_tok = blk[:, -1].copy()
                 now = self.clock()
                 t_acc0 = t_rb + readback_s
@@ -1434,6 +1538,7 @@ class InferenceEngine:
                     for j in range(n_emit):
                         tok = int(blk[slot, j])
                         req.generated.append(tok)
+                        req.logprobs.append(float(logps[slot, j]))
                         produced += 1
                         appended += 1
                         try:
@@ -1524,6 +1629,8 @@ class InferenceEngine:
             self.cache = self._reset(self.cache, self._dev(mask))
         self._flush_freed_pages()
         self._active_dev = None
+        self._planes_dev = None
+        self._pos_dev = None
         self._last_progress_t = None
 
     def run(self, max_steps: int | None = None) -> list[Request]:
@@ -1690,19 +1797,20 @@ class InferenceEngine:
         half, and ``compile_cache_dir=`` makes these compiles land there).
 
         Runs each resident program once with zero/dummy inputs on the IDLE
-        engine: every bucket's prefill(+pick), the window program this
-        mode actually dispatches (decode window, or the verify window in
-        speculative mode), the slot insert/reset, and — paged — every
-        bucket's suffix-extend.  Execution (not ``lower().compile()``)
-        is deliberate: it populates the real jit call caches, so the first
-        request pays ZERO compile anywhere, and the compile events fire
-        under the same ``CompileTracker`` site labels they would at first
-        use — the census budget sees the identical program family, just
-        earlier.  Dummy work is confined to idle-slot garbage the engine's
-        contract already tolerates (all-inactive masks, the trash page,
-        rows an insert overwrites at admission), and the engine's rng
-        stream is never consumed, so prewarmed output is token-identical
-        to cold output.
+        engine: every bucket's prefill, the shared first-token pick, the
+        window program this mode actually dispatches (decode window, or
+        the verify window in speculative mode), the slot insert/reset,
+        and — paged — every bucket's suffix-extend.  Execution (not
+        ``lower().compile()``) is deliberate: it populates the real jit
+        call caches, so the first request pays ZERO compile anywhere, and
+        the compile events fire under the same ``CompileTracker`` site
+        labels they would at first use — the census budget sees the
+        identical program family, just earlier.  Dummy work is confined
+        to idle-slot garbage the engine's contract already tolerates
+        (all-inactive masks, the trash page, rows an insert overwrites at
+        admission), and sampling keys are pure per-request data (no
+        engine-held stream to perturb), so prewarmed output is
+        token-identical to cold output.
 
         Returns ``{"programs", "compile_s", "wall_s", "by_site"}`` — the
         compile delta this call caused (0 programs on a warm persistent
@@ -1719,13 +1827,21 @@ class InferenceEngine:
                 "launch path, before the first submit")
         t0 = self.clock()
         before = self._compile.snapshot()
-        rng = jax.random.PRNGKey(0)  # never self._rng: the stream must
-        # be untouched so prewarmed sampling output == cold output
+        last_logits = None
         for b in self.buckets:
             with self._compile.site(f"prefill[b{b}]"):
-                self._prefill_and_pick(
+                _, last_logits = self._prefill_row(
                     self.params, jnp.zeros((1, b), jnp.int32),
-                    jnp.ones((1,), jnp.int32), rng)
+                    jnp.ones((1,), jnp.int32))
+        # the shared first-token pick over the (1, V) prefill logits —
+        # same program whatever landing path (miss/hit/extend) runs it
+        with self._compile.site("first_pick"):
+            first_pick(last_logits,
+                       self._dev(np.zeros((1,), np.float32)),
+                       self._dev(np.zeros((1,), np.float32)),
+                       self._dev(np.zeros((1, 2), np.uint32)),
+                       self._dev(np.zeros((1,), np.int32)),
+                       top_k=self._top_k)
         # a zeroed B=1 prefill row in the dense decode layout — the same
         # eval_shape probe init_cache uses, so dtypes (incl. int8+scales)
         # match what a real prefill hands to insert
@@ -1755,26 +1871,31 @@ class InferenceEngine:
                         self.params, self.cache, slot0, bt_row,
                         jnp.zeros((1, b), jnp.int32),
                         jnp.asarray(0, jnp.int32),
-                        jnp.asarray(1, jnp.int32), rng)
+                        jnp.asarray(1, jnp.int32))
         else:
             with self._compile.site("slot_insert"):
                 self.cache = self._insert(self.cache, row_cache, slot0)
         inactive = self._dev(np.zeros((self.slots,), bool))
+        temps0 = self._dev(np.zeros((self.slots,), np.float32))
+        topps0 = self._dev(np.zeros((self.slots,), np.float32))
+        keys0 = self._dev(np.zeros((self.slots, 2), np.uint32))
+        pos0 = self._dev(np.zeros((self.slots,), np.int32))
         if self._verify is not None:
             k = self.draft_len + 1
             with self._compile.site(f"verify_window[k{k}]"):
-                self.cache, _, _, _ = self._verify(
+                self.cache, _, _, _, _ = self._verify(
                     self.params, self.cache,
                     self._dev(np.full((self.slots, k), self.pad_id,
                                       np.int32)),
-                    self._dev(np.zeros((self.slots,), np.int32)), inactive)
+                    self._dev(np.zeros((self.slots,), np.int32)), inactive,
+                    temps0, topps0, keys0, pos0)
         else:
             k = self.decode_ahead
             with self._compile.site(f"decode_window[k{k}]"):
-                self.cache, _, _ = self._window(
+                self.cache, _, _, _, _ = self._window(
                     self.params, self.cache,
                     self._dev(np.zeros((self.slots,), np.int32)), inactive,
-                    jnp.broadcast_to(rng, (k,) + rng.shape))
+                    temps0, topps0, keys0, pos0)
         with self._compile.site("slot_reset"):
             self.cache = self._reset(self.cache, inactive)
         delta = CompileTracker.delta(self._compile.snapshot(), before)

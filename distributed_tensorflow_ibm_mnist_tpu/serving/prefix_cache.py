@@ -9,19 +9,23 @@ memoization layer: the engine keys each admission by a blake2b digest of
 its BUCKET-granular prompt (the padded shape is part of the identity —
 the same tokens in a different bucket produce a different cache row
 layout downstream) and, on a hit, reuses the stored prefill cache row and
-first greedy token, skipping the prefill dispatch entirely.
+last-position logits, skipping the prefill dispatch entirely.
 
-Two honest scope limits, by construction:
+Two honest scope notes, by construction:
 
 * **Whole-prompt granularity** — an entry matches only a byte-identical
   (bucket, prompt) pair.  Partial-prefix reuse (split a prompt, reuse the
   shared head) would need per-position cache surgery; the dominant
   real-world case (identical system prompts / repeated requests) is
   whole-prefix anyway.
-* **Greedy only** — the stored first token was argmax-picked; replaying
-  it under ``temperature > 0`` would silently freeze what should be a
-  fresh sample.  The engine refuses to wire a prefix cache to a sampling
-  configuration at construction.
+* **Sampling-safe because nothing sampled is ever stored** (ISSUE 13) —
+  the cache holds only the DETERMINISTIC prefill products (the cache row
+  and the last-position logits), never a picked token.  Every admission —
+  hit or miss — picks its own first token from those logits with its own
+  request's ``(temperature, top_p, seed)`` through the one shared pick
+  program (serving/sampling.py ``first_pick``), so a greedy hit replays
+  the argmax and a sampled hit draws its own seed-keyed sample,
+  bit-identical to what the request would have picked on a miss.
 
 Eviction is byte-bounded LRU (``max_bytes`` over the stored cache rows'
 ``nbytes``), not entry-counted — one long-bucket row can weigh hundreds
@@ -51,10 +55,13 @@ def prefix_key(bucket: int, tokens) -> str:
 class PrefixCache:
     """Byte-bounded LRU of prefill results keyed by :func:`prefix_key`.
 
-    Values are ``(row_cache, first_token)``: the B=1 prefill cache pytree
+    Values are ``(row_cache, payload)``: the B=1 prefill cache pytree
     (device-resident, reused read-only — the engine's slot insert copies
-    it into the slot cache without donating it) and the host-side first
-    greedy token.  ``get`` counts hits/misses for the stats record.
+    it into the slot cache without donating it) and an opaque
+    deterministic payload the caller replays on a hit — the serving
+    engine stores the (1, V) last-position logits and re-picks the first
+    token per request, which is what keeps the cache sampling-safe.
+    ``get`` counts hits/misses for the stats record.
     """
 
     def __init__(self, max_bytes: int):
@@ -69,7 +76,7 @@ class PrefixCache:
         self.oversized = 0  # put() refusals: single entry > max_bytes —
         #   a persistently nonzero count means the budget is sized below
         #   one long-bucket row and the cache can never help that bucket
-        # key -> (row_cache, first_token, entry_bytes); insertion order IS
+        # key -> (row_cache, payload, entry_bytes); insertion order IS
         # recency order (move_to_end on hit)
         self._entries: OrderedDict[str, tuple] = OrderedDict()
 
@@ -77,7 +84,7 @@ class PrefixCache:
         return len(self._entries)
 
     def get(self, key: str):
-        """The (row_cache, first_token) stored under ``key``, or None."""
+        """The (row_cache, payload) stored under ``key``, or None."""
         entry = self._entries.get(key)
         if entry is None:
             self.misses += 1
@@ -86,7 +93,7 @@ class PrefixCache:
         self.hits += 1
         return entry[0], entry[1]
 
-    def put(self, key: str, row_cache, first_token: int) -> None:
+    def put(self, key: str, row_cache, payload) -> None:
         """Store one prefill result, evicting least-recently-used entries
         until the byte budget holds.  An entry larger than the whole
         budget is refused outright and counted (``oversized``) — storing
@@ -95,10 +102,12 @@ class PrefixCache:
             self._entries.move_to_end(key)
             return
         nbytes = int(sum(leaf.nbytes for leaf in jax.tree.leaves(row_cache)))
+        nbytes += int(sum(getattr(leaf, "nbytes", 0)
+                          for leaf in jax.tree.leaves(payload)))
         if nbytes > self.max_bytes:
             self.oversized += 1
             return
-        self._entries[key] = (row_cache, int(first_token), nbytes)
+        self._entries[key] = (row_cache, payload, nbytes)
         self.bytes += nbytes
         while self.bytes > self.max_bytes:
             _, (_, _, nb) = self._entries.popitem(last=False)

@@ -31,6 +31,13 @@ the blake2b cache, the paged engine uses this.
 
 Determinism: LRU ordering uses a monotonic touch counter, not wall-clock,
 so the fault-injection harness (utils/chaos.py) replays identically.
+
+Sampling-safe by construction (ISSUE 13): the trie stores PROMPT blocks
+only — whole ``page_size``-token blocks of the request's prompt, a
+deterministic prefill product.  No sampled (generated) token ever enters
+a shared page, so a sampled request matching a prefix reuses exactly the
+K/V a greedy request would have computed, and picks its own tokens from
+its own seed downstream.
 """
 
 from __future__ import annotations

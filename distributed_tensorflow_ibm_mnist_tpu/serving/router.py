@@ -27,11 +27,13 @@ callback, lapsed deadline) — own failures stay failed, exactly the
 single-engine isolation contract.  Collateral requests re-dispatch to
 survivors with the failed replica excluded (the ``excluded``-set retry
 pattern) and their REMAINING deadline recomputed.  A re-dispatched request
-regenerates from token zero — greedy decode is deterministic, so the
-replayed prefix is token-identical and the per-request delivered-token
-high-water mark turns at-most-once delivery per attempt into exactly-once
-delivery per TOKEN across attempts (the streaming guarantee is greedy-only,
-like the prefix cache, and for the same reason).
+regenerates from token zero — decode is deterministic per request (greedy
+by construction; sampled because a stream is a pure function of its
+``SamplingParams`` seed, serving/sampling.py), so the replayed prefix is
+token-identical and the per-request delivered-token high-water mark turns
+at-most-once delivery per attempt into exactly-once delivery per TOKEN
+across attempts, greedy and sampled alike (ISSUE 13; chaos-gated in
+tests/test_sampling.py).
 
 Hot swap — :class:`WeightWatcher` polls the trainer's checkpoint directory
 on its OWN read-only :class:`~..utils.checkpoint.CheckpointManager` (its
@@ -99,13 +101,19 @@ class RouterRequest:
                  deadline_s: float | None, submit_t: float,
                  callback: Callable | None,
                  ttft_slo_s: float | None = None,
-                 tpot_slo_s: float | None = None):
+                 tpot_slo_s: float | None = None,
+                 sampling=None):
         self.id = rid
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.max_new = int(max_new)
         self.deadline_s = deadline_s      # relative to submit_t, like Request
         self.submit_t = submit_t          # router clock at FIRST dispatch
         self.callback = callback          # the USER's hook; router wraps it
+        # per-request SamplingParams, identical on every attempt — the
+        # seed makes a failover replay token-identical, which is what
+        # keeps the delivered high-water mark exactly-once for SAMPLED
+        # streams too (module docstring)
+        self.sampling = sampling
         # SLO targets ride along to every attempt's engine Request.  The
         # SLO clock is PER-ATTEMPT (each attempt's submit_t), matching
         # deadline_s semantics: a failed-over attempt is judged on its own
@@ -134,6 +142,10 @@ class RouterRequest:
     @property
     def generated(self) -> list[int]:
         return self.req.generated if self.req is not None else []
+
+    @property
+    def logprobs(self) -> list[float]:
+        return self.req.logprobs if self.req is not None else []
 
     @property
     def error(self) -> str | None:
@@ -232,18 +244,22 @@ class Router:
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
                callback: Callable | None = None,
                ttft_slo_s: float | None = None,
-               tpot_slo_s: float | None = None) -> RouterRequest:
+               tpot_slo_s: float | None = None,
+               sampling=None) -> RouterRequest:
         """Place one request on the least-loaded healthy replica.  Raises
         :class:`NoHealthyReplica` when no replica can be tried and
         :class:`QueueFull` when every healthy replica's queue is at bound
         (backpressure — the caller sheds or retries, as with one engine).
         ``ttft_slo_s``/``tpot_slo_s`` ride to every attempt (see
-        :class:`RouterRequest` for the per-attempt clock semantics)."""
+        :class:`RouterRequest` for the per-attempt clock semantics);
+        ``sampling`` (serving/sampling.SamplingParams) rides identically,
+        so a failover replay consumes the same seed."""
         if self._closed:
             raise RuntimeError("router is closed")
         rr = RouterRequest(next(self._ids), prompt, max_new, deadline_s,
                            self.clock(), callback,
-                           ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s)
+                           ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s,
+                           sampling=sampling)
         self._dispatch(rr)   # propagates QueueFull / NoHealthyReplica
         self.requests.append(rr)
         return rr
@@ -305,7 +321,8 @@ class Router:
                                         deadline_s=remaining,
                                         callback=self._wrap_callback(rr),
                                         ttft_slo_s=rr.ttft_slo_s,
-                                        tpot_slo_s=rr.tpot_slo_s)
+                                        tpot_slo_s=rr.tpot_slo_s,
+                                        sampling=rr.sampling)
             except QueueFull:
                 full.append(rep)
                 continue

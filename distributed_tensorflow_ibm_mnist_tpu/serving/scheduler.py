@@ -34,6 +34,7 @@ from typing import Callable
 import numpy as np
 
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import prefix_key
+from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import SamplingParams
 
 
 class QueueFull(RuntimeError):
@@ -88,6 +89,13 @@ class Request:
     prefix_key: str | None = None       # blake2b content address of the
     #   (bucket, prompt) pair — the prefix-cache lookup key
     #   (serving/prefix_cache.py); filled by the scheduler at submit
+    sampling: "SamplingParams | None" = None  # per-request sampling config
+    #   (serving/sampling.py), validated at submit; None = the engine's
+    #   default (its temperature/top_p/rng construction knobs)
+    logprobs: list[float] = field(default_factory=list)  # engine: one
+    #   log_softmax(raw logits)[token] per generated token (the model's
+    #   pre-temperature distribution — comparable across sampling configs;
+    #   len(logprobs) == len(generated) at every point in the lifecycle)
     pages: int = 0                      # paged engine: KV pages this
     #   request's block table spans (shared radix pages included); 0 on
     #   the dense layout — the per-request HBM footprint record
@@ -156,11 +164,16 @@ class FIFOScheduler:
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
                callback: Callable | None = None,
                ttft_slo_s: float | None = None,
-               tpot_slo_s: float | None = None) -> Request:
+               tpot_slo_s: float | None = None,
+               sampling: SamplingParams | None = None) -> Request:
         """Enqueue one request; raises :class:`QueueFull` (backpressure) or
         ``ValueError`` (request can never be served).  ``callback`` is the
         per-token streaming hook; ``ttft_slo_s``/``tpot_slo_s`` are the
-        optional latency SLO targets (see :class:`Request`)."""
+        optional latency SLO targets (see :class:`Request`); ``sampling``
+        is the per-request :class:`SamplingParams` (None = engine
+        default) — already validated by its own constructor, the type is
+        checked here so a stray ``(temp, top_p)`` tuple fails at submit,
+        not mid-decode."""
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("empty prompt")
@@ -174,6 +187,9 @@ class FIFOScheduler:
             raise ValueError(f"tpot_slo_s must be > 0, got {tpot_slo_s}")
         if callback is not None and not callable(callback):
             raise ValueError("callback must be callable")
+        if sampling is not None and not isinstance(sampling, SamplingParams):
+            raise ValueError(
+                f"sampling must be a SamplingParams, got {type(sampling).__name__}")
         if tokens.size + max_new > self.max_len:
             raise ValueError(
                 f"prompt ({tokens.size}) + max_new ({max_new}) exceeds the "
@@ -189,7 +205,8 @@ class FIFOScheduler:
                       bucket=bucket, deadline_s=deadline_s,
                       submit_t=self.clock(), callback=callback,
                       ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s,
-                      prefix_key=prefix_key(bucket, tokens))
+                      prefix_key=prefix_key(bucket, tokens),
+                      sampling=sampling)
         if self.tracer is not None:
             # root span of this request's tree, on its own viewer track;
             # "queue" is the first lifecycle phase (closed at pop, or at

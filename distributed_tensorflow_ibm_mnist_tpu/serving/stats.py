@@ -37,6 +37,14 @@ The serving analog of the trainer's metrics-of-record discipline
   for ONE k-position forward).  Both are None — never NaN — when their
   denominators are zero, so dense/plain records keep a stable schema.
 
+* **sampling** (ISSUE 13) — ``n_sampled_requests`` (requests whose own
+  :class:`~..serving.sampling.SamplingParams` decoded with temperature
+  > 0), ``mean_temperature`` over those (None when none — never a
+  fictitious zero-mean), and a streaming per-token NLL histogram
+  (``-logprob`` under the raw-logits convention, every generated token,
+  greedy rows included) whose p50/p95/p99 come from a
+  utils/telemetry.HistogramSketch — fixed memory at any token count, and
+  the sketches merge bucket-wise in the router rollup.
 * **SLO / goodput** (ISSUE 11) — a request may declare latency targets
   ``(ttft_slo_s, tpot_slo_s)`` (serving/scheduler.Request); the engine
   judges TTFT at first token and TPOT at retirement.  A *tracked* request
@@ -69,6 +77,7 @@ import numpy as np
 
 from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import Request
 from distributed_tensorflow_ibm_mnist_tpu.utils.metrics import MetricWriter
+from distributed_tensorflow_ibm_mnist_tpu.utils.telemetry import HistogramSketch
 
 
 def slo_verdict(req: "Request") -> str | None:
@@ -151,6 +160,16 @@ class ServingStats:
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._spec_corrected = 0
+        # --- per-request sampling accounting (ISSUE 13) --- all zero on
+        # greedy-only traffic, so the schema stays stable.  The NLL sketch
+        # holds -logprob per generated token (every request — greedy rows
+        # included, their logprobs are the same raw-logits convention), a
+        # streaming model-confidence figure; [1e-4, 1e2] nats spans
+        # near-certain (1e-4) to vocab-uniform-at-any-real-vocab (1e2)
+        self._n_sampled = 0          # requests that decoded with temp > 0
+        self._temp_sum = 0.0         # over sampled requests only
+        self._n_logprob_tokens = 0
+        self._nll = HistogramSketch(lo=1e-4, hi=1e2)
         # --- paged KV pool + radix prefix accounting (ISSUE 7) --- the
         # engine samples pool occupancy each step (pool_sample) and records
         # each admission's radix-match outcome (radix); all zero/None for
@@ -266,6 +285,18 @@ class ServingStats:
         if req.engine_fault:
             self._n_engine_fault += 1
         self._tokens += len(req.generated)
+        # sampling accounting (ISSUE 13): a request is "sampled" when its
+        # own SamplingParams asked for temperature > 0 (engine-default
+        # sampling is a construction knob, not per-request traffic mix);
+        # NLL is recorded for EVERY generated token — greedy rows share
+        # the raw-logits logprob convention, so the sketch is one
+        # model-confidence distribution across the whole traffic
+        if req.sampling is not None and req.sampling.sampled:
+            self._n_sampled += 1
+            self._temp_sum += float(req.sampling.temperature)
+        for lp in req.logprobs:
+            self._nll.record(-lp)
+        self._n_logprob_tokens += len(req.logprobs)
         verdict = slo_verdict(req)
         if verdict is not None:
             self._slo_tracked += 1
@@ -370,6 +401,20 @@ class ServingStats:
                       / self._windows, 4)
                 if self._windows > 0 else None
             ),
+            # per-request sampling (ISSUE 13; all-zero/None on greedy-only
+            # traffic).  mean_temperature averages SAMPLED requests only —
+            # folding greedy zeros in would report a fictitious lukewarm
+            # cluster.  NLL percentiles stream from the sketch (no stored
+            # per-token samples), None when no token recorded a logprob.
+            "n_sampled_requests": self._n_sampled,
+            "mean_temperature": (
+                round(self._temp_sum / self._n_sampled, 4)
+                if self._n_sampled > 0 else None
+            ),
+            "logprob_tokens": self._n_logprob_tokens,
+            "nll_p50": self._nll.percentile(50),
+            "nll_p95": self._nll.percentile(95),
+            "nll_p99": self._nll.percentile(99),
             # paged KV pool (all-zero/None on dense engines)
             "kv_page_size": self._kv_page_size or None,
             "kv_pages_total": self._kv_pages_total,
@@ -427,6 +472,7 @@ class ServingStats:
                                if r_total > 0 else None),
             "accept_rate": (round(self._spec_accepted / self._spec_drafted, 4)
                             if self._spec_drafted > 0 else None),
+            "n_sampled_requests": self._n_sampled,
             "kv_pages_live": self._kv_pages_live,
             "kv_pages_total": self._kv_pages_total,
             "slo_tracked": self._slo_tracked,
@@ -484,6 +530,9 @@ class ServingStats:
         r_hits = sum(rec._radix_hits for rec in records)
         r_miss = sum(rec._radix_misses for rec in records)
         compiled = [rec._compile for rec in records if rec._compile is not None]
+        n_sampled = sum(rec._n_sampled for rec in records)
+        temp_sum = sum(rec._temp_sum for rec in records)
+        nll = HistogramSketch.merge([rec._nll for rec in records])
         # replicas hold DISJOINT TP groups (parallel/tensor_parallel.
         # tp_device_groups), so the cluster's per-chip figure is the worst
         # chip anywhere (max), the cluster total sums per_chip * tp per
@@ -541,6 +590,18 @@ class ServingStats:
             "useful_tokens_per_window": (
                 round((w_steps - waste) / n_windows, 4)
                 if n_windows > 0 else None),
+            # sampling (ISSUE 13): counters sum, mean_temperature
+            # re-derives over the merged sampled-request count (a mean of
+            # means overweights idle engines), the NLL sketches merge
+            # bucket-wise (HistogramSketch.merge) so cluster percentiles
+            # come from one histogram, not a percentile of percentiles
+            "n_sampled_requests": n_sampled,
+            "mean_temperature": (round(temp_sum / n_sampled, 4)
+                                 if n_sampled > 0 else None),
+            "logprob_tokens": sum(rec._n_logprob_tokens for rec in records),
+            "nll_p50": nll.percentile(50),
+            "nll_p95": nll.percentile(95),
+            "nll_p99": nll.percentile(99),
             "kv_pages_total": sum(rec._kv_pages_total for rec in records),
             "kv_pages_live": sum(rec._kv_pages_live for rec in records),
             "kv_pages_peak": sum(rec._kv_pages_peak for rec in records),

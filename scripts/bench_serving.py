@@ -89,6 +89,20 @@ Two more legs (ISSUE 7, paged KV):
   TTFT — the launch path absorbing the compile bill instead of the
   first request.
 
+One more block (ISSUE 13, run via ``--sampling-only`` so bench.py can
+skip it independently with ``DTM_BENCH_SKIP_SAMPLING``):
+
+* **sampling** — per-request temperature/top_p/seed decode: the
+  greedy-limit gate (``SamplingParams(temperature=0)`` token-identical
+  to plain greedy on dense AND speculative engines), the seeded-replay
+  gate (the sampled stream served twice is token-identical — the
+  carried-PRNG contract), and the speculative rejection-sampling
+  figures (acceptance rate + useful tokens/sec for sampled spec
+  traffic beside the greedy-spec floor).  Gate breaches exit 3.  The
+  main serving record's compile census additionally pins
+  ``sample_cold``/``sample_repeat`` at ZERO new programs — sampling
+  configs are data planes in one program family, never new programs.
+
 ``DTM_BENCH_QUICK=1`` shrinks models/streams to a CI smoke of the same
 code paths (exercised by a ``slow``-marked test so harness rot is caught
 without paying the full sweep); the record carries ``"quick": true``.
@@ -337,27 +351,162 @@ def run_prefix_cache(model, params, slots: int, repeats: int) -> dict:
     }
 
 
+def run_sampling(slots: int, requests: int) -> dict:
+    """ISSUE 13 acceptance, bench-shaped (``--sampling-only`` block):
+
+    * **greedy_limit** — the SAME stream served plain-greedy vs with an
+      explicit ``SamplingParams(temperature=0)`` per request, on a dense
+      AND a speculative engine: temperature -> 0 collapses the tempered
+      softmax to argmax, so the outputs must be token-identical.  Any
+      mismatch is a HARD gate (exit 3) — the sampling plumbing must be
+      invisible when it is off.
+    * **seeded_replay** — the sampled stream (temperature 0.8, top_p
+      0.9, per-request seeds) served TWICE through the same engine:
+      token-identical replay is the carried-PRNG contract (a request's
+      stream is a pure function of its seed and generated position,
+      never of slot placement or admission order).  Also a hard gate.
+    * **speculative sampling** — the spec engine serves the sampled
+      stream by rejection sampling inside the verify window: acceptance
+      rate and useful tokens/sec are REPORTED beside the greedy-spec
+      floor, not parity-gated against plain sampling — rejection
+      sampling preserves the target DISTRIBUTION, not the sample path
+      (the distribution itself is chi-squared-gated in
+      tests/test_sampling.py; only the temperature->0 limit is
+      token-identical, and greedy_limit covers that on this engine too).
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+        SamplingParams,
+        ServingStats,
+    )
+
+    max_len = BUCKET + LONG_NEW + 8
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DA_DIM,
+                      depth=DA_DEPTH, heads=DA_HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(6),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    stream = make_stream(requests, seed=8)
+    warm = make_stream(max(slots * 2, 8), seed=9)
+    none_sp = [None] * len(stream)
+    zero_t = [SamplingParams(temperature=0.0, seed=i * 11 + 3)
+              for i in range(len(stream))]
+    sampled = [SamplingParams(temperature=0.8, top_p=0.9, seed=i * 11 + 3)
+               for i in range(len(stream))]
+
+    def build(**kw):
+        # warmed outside the timed region, like every other leg: the
+        # comparison is sustained serving, not compile time
+        eng = InferenceEngine(
+            model, params, slots=slots, max_len=max_len,
+            scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET,),
+                                    max_queue=max(len(stream), len(warm))),
+            **kw)
+        for p, mn in warm:
+            eng.submit(p, max_new=mn)
+        eng.run()
+        return eng
+
+    def serve(eng, sampling):
+        eng.completed.clear()
+        eng.stats = ServingStats(slots, decode_ahead=eng.decode_ahead)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new=mn, sampling=sp)
+                for (p, mn), sp in zip(stream, sampling)]
+        eng.run()
+        el = time.perf_counter() - t0
+        useful = sum(len(r.generated) for r in reqs)
+        out = [np.asarray(r.generated) for r in reqs]
+        return el, useful, out, eng.stats.summary()
+
+    eng = build()
+    _, _, greedy_out, _ = serve(eng, none_sp)
+    _, _, zerot_out, _ = serve(eng, zero_t)
+    s_el, s_useful, s1_out, s_summ = serve(eng, sampled)
+    _, _, s2_out, _ = serve(eng, sampled)
+    eng.close()
+    mism_greedy = sum(not np.array_equal(a, b)
+                      for a, b in zip(greedy_out, zerot_out))
+    mism_replay = sum(not np.array_equal(a, b)
+                      for a, b in zip(s1_out, s2_out))
+
+    seng = build(speculative="ngram", draft_len=3)
+    sg_el, sg_useful, sg_out, sg_summ = serve(seng, none_sp)
+    _, _, sz_out, _ = serve(seng, zero_t)
+    ss_el, ss_useful, ss1_out, ss_summ = serve(seng, sampled)
+    _, _, ss2_out, _ = serve(seng, sampled)
+    seng.close()
+    mism_greedy += sum(not np.array_equal(a, b)
+                       for a, b in zip(sg_out, sz_out))
+    mism_replay += sum(not np.array_equal(a, b)
+                       for a, b in zip(ss1_out, ss2_out))
+
+    return {
+        "model": {"dim": DA_DIM, "depth": DA_DEPTH, "heads": DA_HEADS},
+        "n_requests": len(stream),
+        "params": {"temperature": 0.8, "top_p": 0.9},
+        # the HARD gates (exit 3 on breach), dense + spec engines both:
+        "greedy_limit_mismatches": mism_greedy,  # MUST be 0
+        "replay_mismatches": mism_replay,        # MUST be 0
+        "gates_ok": not (mism_greedy or mism_replay),
+        # sampled-traffic accounting from the dense engine's stats
+        "sampled_tokens_per_sec": round(s_useful / s_el, 2),
+        "n_sampled_requests": s_summ["n_sampled_requests"],
+        "mean_temperature": s_summ["mean_temperature"],
+        "nll_p50": s_summ["nll_p50"],
+        "nll_p95": s_summ["nll_p95"],
+        # rejection sampling vs greedy verify on the SAME spec engine:
+        # the greedy row is the comparison floor — sampled acceptance
+        # is expected at-or-below it (accepting a draft now costs a
+        # Bernoulli trial, not an argmax match), and the figures say
+        # what that costs in useful tokens per dispatch
+        "spec": {
+            "greedy": {
+                "accept_rate": sg_summ["accept_rate"],
+                "useful_tokens_per_window":
+                    sg_summ["useful_tokens_per_window"],
+                "tokens_per_sec": round(sg_useful / sg_el, 2),
+            },
+            "sampled": {
+                "accept_rate": ss_summ["accept_rate"],
+                "useful_tokens_per_window":
+                    ss_summ["useful_tokens_per_window"],
+                "tokens_per_sec": round(ss_useful / ss_el, 2),
+            },
+        },
+    }
+
+
 # Pinned per-leg budgets for the compile census (ISSUE 7 satellite: the
 # census is a regression GATE, not just a report — a leg exceeding its
 # budget means a program-family leak, and the bench exits nonzero).  The
 # numbers are the MEASURED cold sets of the current engine, pinned exact:
 # one extra program in any leg is the regression the gate exists to catch.
 CENSUS_BUDGET = {
-    "bucket16_first": 7,    # prefill[b16] (+pick) + window + insert + reset
-    #                         + 2 unattributed helper jits
+    "bucket16_first": 10,   # 2 under prefill[b16] + first_pick (the ISSUE
+    #                         13 split: prefill emits raw logits, the
+    #                         SHARED sample-aware pick program picks at
+    #                         landing) + window + insert + reset + 4
+    #                         unattributed helper jits
     "bucket16_repeat": 0,   # repeats compile NOTHING
     "bucket32_new": 1,      # the new bucket's prefill only
     "bucket32_repeat": 0,
     "paged_cold": 5,        # paged prefill/insert/window/reset + extend
+    #                         (first_pick is MODULE-level and already
+    #                         warm from the dense engine)
     "paged_repeat": 0,      # paging adds programs once, not per request
-    "spec_cold": 7,         # prefill[b16](+pick) + verify_window[k4] +
-    #                         insert + reset + 2 unattributed helper jits
+    "spec_cold": 4,         # prefill[b16] + verify_window[k4] + insert +
+    #                         reset; first_pick and the helper jits are
+    #                         shared module-level programs the dense legs
+    #                         already warmed
     "spec_repeat": 0,       # speculation adds its programs once too
-    "tp_cold": 6,           # the dense serve family under GSPMD — prefill
-    #                         (+pick), window, insert, reset + 2
-    #                         unattributed helper jits; the sharded
-    #                         cache-alloc/param-upload programs compile at
-    #                         engine CONSTRUCTION, before this leg's delta
+    "tp_cold": 8,           # the dense serve family under GSPMD — prefill,
+    #                         first_pick (recompiles: sharded inputs),
+    #                         window, insert, reset + 3 unattributed helper
+    #                         jits; the sharded cache-alloc/param-upload
+    #                         programs compile at engine CONSTRUCTION,
+    #                         before this leg's delta
     "tp_repeat": 0,         # tp changes program CONTENTS, never counts
     "quant_cold": 4,        # prefill + insert + window + reset with int8
     #                         kernels inside — the dense cold set minus
@@ -365,6 +514,15 @@ CENSUS_BUDGET = {
     #                         already warmed; quant must NOT fork the
     #                         program family past these four sites
     "quant_repeat": 0,      # the int8 tree must not flap jit cache keys
+    "sample_cold": 0,       # sampling is DATA, not program shape (ISSUE
+    #                         13): temperature/top_p/key ride the decode
+    #                         carry as per-slot planes through the SAME
+    #                         window/prefill programs, so a sampled
+    #                         request on the warmed dense engine compiles
+    #                         NOTHING — even its first one
+    "sample_repeat": 0,     # and a DIFFERENT (temp, top_p, seed) config
+    #                         compiles nothing either: one program family
+    #                         across every sampling config
 }
 
 # Per-site pins for the speculative leg (ISSUE 9): the verify window is
@@ -394,6 +552,11 @@ def run_compile_census(slots: int) -> dict:
        decode window; ``slot_draft`` must compile NOTHING — per-site pins
        in ``SPEC_SITE_BUDGET``);
     8. spec_repeat: zero.
+    4b. sample_cold / sample_repeat (ISSUE 13): sampled requests on the
+       SAME warmed dense engine — distinct (temperature, top_p, seed)
+       configs are per-slot data planes in the decode carry, so BOTH
+       legs pin ZERO new programs (the one-program-family acceptance
+       criterion, census-shaped);
     9. quant_cold (ISSUE 12): a fresh int8 weight-quant engine compiles
        the SAME program set as the dense cold engine — the family is
        quant-BLIND (int8 kernels/scales change what programs contain,
@@ -409,6 +572,7 @@ def run_compile_census(slots: int) -> dict:
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
         FIFOScheduler,
         InferenceEngine,
+        SamplingParams,
     )
     from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
 
@@ -424,10 +588,10 @@ def run_compile_census(slots: int) -> dict:
                                 max_queue=8))
     rng = np.random.default_rng(5)
 
-    def serve_one(engine, prompts):
+    def serve_one(engine, prompts, sampling=None):
         before = tracker.snapshot()
         for p in prompts:
-            engine.submit(p, max_new=SHORT_NEW)
+            engine.submit(p, max_new=SHORT_NEW, sampling=sampling)
         engine.run()
         d = CompileTracker.delta(tracker.snapshot(), before)
         return {"n_new_programs": d["n_compiled_programs"],
@@ -441,6 +605,15 @@ def run_compile_census(slots: int) -> dict:
         "bucket16_repeat": serve_one(eng, [rand_prompt(10)]),  # same bucket
         "bucket32_new": serve_one(eng, [rand_prompt(24)]),
         "bucket32_repeat": serve_one(eng, [rand_prompt(28)]),
+        # sampling is data, not program shape (ISSUE 13): the warmed
+        # dense engine serves its FIRST sampled request — and then a
+        # different (temperature, top_p, seed) config — compiling nothing
+        "sample_cold": serve_one(
+            eng, [rand_prompt(8)],
+            sampling=SamplingParams(temperature=0.8, top_p=0.9, seed=11)),
+        "sample_repeat": serve_one(
+            eng, [rand_prompt(10)],
+            sampling=SamplingParams(temperature=1.1, top_p=0.5, seed=12)),
     }
     # the paged program family: a fresh paged engine (page pool + radix)
     # serving a shared-prefix pair — the second request radix-matches the
@@ -523,6 +696,7 @@ def run_compile_census(slots: int) -> dict:
             and legs["paged_repeat"]["n_new_programs"] == 0
             and legs["spec_repeat"]["n_new_programs"] == 0
             and legs["quant_repeat"]["n_new_programs"] == 0
+            and legs["sample_repeat"]["n_new_programs"] == 0
             and legs.get("tp_repeat", {"n_new_programs": 0})[
                 "n_new_programs"] == 0),
         "new_bucket_compiles": legs["bucket32_new"]["n_new_programs"] > 0,
@@ -923,12 +1097,31 @@ def main() -> None:
     ap.add_argument("--prewarm", action="store_true",
                     help="internal: with --compile-cache-probe, call "
                          "engine.prewarm() before the first submit")
+    ap.add_argument("--sampling-only", action="store_true",
+                    help="run ONLY the ISSUE 13 sampling block (greedy-"
+                         "limit + seeded-replay gates, speculative "
+                         "rejection-sampling figures) and print its own "
+                         "JSON record — bench.py's `sampling` block")
     args = ap.parse_args()
     if args.compile_cache_probe is not None:
         _compile_cache_probe(args.compile_cache_probe, prewarm=args.prewarm)
         return
     if QUICK:
         args.requests = min(args.requests, 10)
+    if args.sampling_only:
+        rec = run_sampling(args.slots, 16 if QUICK else args.requests)
+        rec = {"metric": "sampling", **rec, "quick": QUICK,
+               "device": str(jax.devices()[0])}
+        print(json.dumps(rec), flush=True)
+        # the parity gates: temperature->0 that changes tokens, or a
+        # seeded replay that drifts, is a correctness regression — fail
+        # the block AFTER the record prints
+        if not rec["gates_ok"]:
+            print(f"sampling gates failed: greedy_limit_mismatches="
+                  f"{rec['greedy_limit_mismatches']} replay_mismatches="
+                  f"{rec['replay_mismatches']}", file=sys.stderr)
+            sys.exit(3)
+        return
 
     # tensor-parallel census legs (ISSUE 10) need a multi-chip platform;
     # arm it before ANY jax array exists — single-device legs are

@@ -17,7 +17,11 @@ Reads the append-mode JSONL the :class:`Telemetry` health sampler writes
   the final rolling-window p99;
 * the **SLO table** — per engine source: tracked/met/miss counters and
   the met rate, plus the cluster goodput over the sampled span
-  (SLO-met requests per second — the ROADMAP item 3 gated metric).
+  (SLO-met requests per second — the ROADMAP item 3 gated metric);
+* the **sampling table** (ISSUE 13) — per engine source carrying
+  ``n_sampled_requests`` in its vitals: retired sampled-decode requests
+  vs total, with the cluster sampled-traffic fraction — the "how much of
+  this fleet's traffic is temperature > 0" view.
 
 ``--json`` emits the same dict as one machine-readable line.
 ``--strict`` exits nonzero on any unparseable line, non-dict record, or
@@ -81,7 +85,7 @@ def analyze(records: list[dict]) -> dict:
     if not records:
         return {"n_samples": 0, "span_s": None, "sources": [],
                 "source_errors": 0, "counters": {}, "gauges": {},
-                "histograms": {}, "slo": None}
+                "histograms": {}, "slo": None, "sampling": None}
     t0, t1 = records[0]["t"], records[-1]["t"]
     span = t1 - t0 if t1 > t0 else None
     first, last = records[0], records[-1]
@@ -156,6 +160,32 @@ def analyze(records: list[dict]) -> dict:
                             if span and tot_tracked else None),
         }
 
+    # sampling table (ISSUE 13): per source carrying n_sampled_requests
+    # vitals — sampled vs total retired requests, cluster fraction from
+    # the summed counters (the ServingStats.merge discipline)
+    samp_rows = []
+    tot_sampled = tot_reqs = 0
+    for sname in sorted(source_names):
+        vit = (last.get("sources") or {}).get(sname) or {}
+        if not isinstance(vit, dict) or "n_sampled_requests" not in vit:
+            continue
+        sampled = vit.get("n_sampled_requests") or 0
+        nreq = vit.get("n_requests") or 0
+        tot_sampled += sampled
+        tot_reqs += nreq
+        samp_rows.append({
+            "source": sname, "sampled": sampled, "requests": nreq,
+            "sampled_frac": round(sampled / nreq, 4) if nreq else None,
+        })
+    sampling = None
+    if samp_rows:
+        sampling = {
+            "per_source": samp_rows,
+            "sampled": tot_sampled, "requests": tot_reqs,
+            "sampled_frac": (round(tot_sampled / tot_reqs, 4)
+                             if tot_reqs else None),
+        }
+
     return {
         "n_samples": len(records),
         "span_s": round(span, 6) if span else None,
@@ -165,6 +195,7 @@ def analyze(records: list[dict]) -> dict:
         "gauges": gauges,
         "histograms": histograms,
         "slo": slo,
+        "sampling": sampling,
     }
 
 
@@ -235,6 +266,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f"  cluster: tracked={s['tracked']} met={s['met']} "
               f"miss={s['miss']} met_rate={s['met_rate']} "
               f"goodput_rps={s['goodput_rps']}")
+    if report.get("sampling"):
+        s = report["sampling"]
+        print("\nSampling (temperature > 0) traffic:")
+        print(_fmt_table(s["per_source"],
+                         ["source", "sampled", "requests", "sampled_frac"]))
+        print(f"  cluster: sampled={s['sampled']} requests={s['requests']} "
+              f"sampled_frac={s['sampled_frac']}")
     return 0
 
 

@@ -42,6 +42,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_SPEC": ["speculative"],
     "DTM_BENCH_SKIP_TRAIN_CENSUS": ["train_census"],
     "DTM_BENCH_SKIP_QUANT": ["quant"],
+    "DTM_BENCH_SKIP_SAMPLING": ["sampling"],
 }
 
 

@@ -11,9 +11,11 @@ The decisive properties:
 * BOUNDED WASTE — EOS/budget/deadline retirement mid-window discards the
   ≤k−1 overrun tokens (never delivered, never counted) and the KV cursor
   clamps at ``max_len`` so overrun writes stay inside the row.
-* PREFIX CACHE — a hit replays the stored prefill row + first token
-  (prefill dispatch skipped, output identical); the LRU is byte-bounded;
-  wiring the cache to a sampling engine is refused at construction.
+* PREFIX CACHE — a hit reuses the stored prefill row + last-position
+  logits (prefill dispatch skipped, output identical — every admission
+  re-picks its own first token, so the cache is sampling-safe; ISSUE 13
+  lifted the old greedy-only construction guard); the LRU is
+  byte-bounded.
 * CONTRACT — the chaos ``serving-step`` site counts WINDOWS (one event
   per dispatch, stable across k) and the engine/scheduler bucket sets
   cannot silently drift apart.
@@ -297,13 +299,13 @@ def test_prefix_cache_hit_skips_prefill_with_identical_output():
 
     eng = _engine(model, params, decode_ahead=2, prefix_cache_bytes=64 << 20)
     calls = {"n": 0}
-    real = eng._prefill_and_pick
+    real = eng._dense_prefill
 
     def counting(*a, **kw):
         calls["n"] += 1
         return real(*a, **kw)
 
-    eng._prefill_and_pick = counting
+    eng._dense_prefill = counting
     r1 = eng.submit(prompt, max_new=5)
     eng.run()
     assert calls["n"] == 1
@@ -326,7 +328,7 @@ def test_prefix_cache_hit_skips_prefill_with_identical_output():
 
 def test_prefix_cache_lru_eviction_and_refusals():
     """Unit contract of the byte-bounded LRU: eviction order, oversized
-    refusal, and the greedy-only constructor guard on the engine."""
+    refusal — and the ISSUE 13 lift of the old greedy-only engine guard."""
     row = {"k": np.zeros((64,), np.float32)}  # 256 bytes per entry
     pc = PrefixCache(max_bytes=600)
     pc.put("a", row, 1)
@@ -346,9 +348,12 @@ def test_prefix_cache_lru_eviction_and_refusals():
         PrefixCache(max_bytes=0)
 
     model, params = _model_and_params(seed=9)
-    with pytest.raises(ValueError, match="GREEDY"):
-        _engine(model, params, prefix_cache_bytes=1 << 20,
-                temperature=0.7, rng=jax.random.PRNGKey(0))
+    # ISSUE 13 lifted the old cache+sampling refusal: the cache stores
+    # only deterministic prefill products (row + logits) and every
+    # admission re-picks its own first token, so this must now construct
+    eng = _engine(model, params, prefix_cache_bytes=1 << 20,
+                  temperature=0.7, rng=jax.random.PRNGKey(0))
+    eng.close()
 
 
 # ----------------------------------------------------------------------

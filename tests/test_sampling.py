@@ -1,0 +1,389 @@
+"""Per-request sampling (ISSUE 13): params, seeded purity, distribution.
+
+The decisive properties:
+
+* SEEDED PURITY — a request's token stream is a pure function of its
+  ``(prompt, SamplingParams)``: identical across ``decode_ahead``
+  {1, 4, 8}, dense vs paged layouts, an engine restart, and a replay on
+  a speculative engine at fixed config.  Position-keyed PRNG
+  (``fold_in(base_key, n)`` for the token at generated index ``n``) is
+  what buys this — the host's windowing never touches the key schedule.
+* GREEDY LIMIT — ``temperature == 0`` requests are token-identical to
+  the engine's greedy output across layouts × decode_ahead ×
+  ±speculative: sampling rows ride the SAME program, selected by data.
+* ONE PROGRAM FAMILY — after prewarm, serving any mix of per-request
+  ``(temperature, top_p, seed)`` configs compiles ZERO new programs.
+* DISTRIBUTION — the speculative verify's rejection sampling (accept a
+  draft with prob ``p_target(d)``, resample the masked residual on
+  reject) emits the target sampling distribution exactly; chi-squared
+  gated over >= 10k draws on a small vocab, for both a high-probability
+  and an adversarial (least-likely) draft.
+* EXACTLY-ONCE — a chaos-killed replica's sampled requests replay
+  token-identical on a survivor with exactly-once streaming delivery.
+* STATS — sampled-request accounting (counts, mean temperature, NLL
+  histogram) flows through ``ServingStats`` and the router rollup.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
+    _tempered_rows,
+    _verify_sample_core,
+    make_decode_step,
+    make_prefill,
+)
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
+    InferenceEngine,
+    Router,
+    SamplingParams,
+    ServingStats,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import base_key
+from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6]]
+
+# chi-squared 99.9th-percentile critical values by dof (no scipy in the
+# image; a fixed table keeps the gate dependency-free)
+CHI2_999 = {1: 10.83, 2: 13.82, 3: 16.27, 4: 18.47, 5: 20.52,
+            6: 22.46, 7: 24.32}
+
+
+def _model_and_params(seed=0, **over):
+    model = get_model("causal_lm", **{**KW, **over})
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("buckets", (8,))
+    return InferenceEngine(model, params, **kw)
+
+
+def _serve(model, params, sampling=None, prompts=PROMPTS, max_new=8, **kw):
+    """Serve the wave; returns (token lists, logprob lists).  ``sampling``
+    is one SamplingParams for every request or a per-request list."""
+    eng = _engine(model, params, **kw)
+    if not isinstance(sampling, (list, tuple)):
+        sampling = [sampling] * len(prompts)
+    reqs = [eng.submit(np.asarray(p, np.int32), max_new=max_new, sampling=s)
+            for p, s in zip(prompts, sampling)]
+    eng.run()
+    eng.close()
+    assert all(r.status == "done" for r in reqs)
+    return ([list(r.generated) for r in reqs],
+            [list(r.logprobs) for r in reqs])
+
+
+# ----------------------------------------------------------------------
+# SamplingParams: validation at submit, key derivation
+
+
+def test_sampling_params_validation_and_key():
+    assert not SamplingParams().sampled              # greedy default
+    assert SamplingParams(temperature=0.7).sampled
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingParams(temperature=float("nan"))
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(temperature=1.0, top_p=-0.2)
+    # top_p filters a sampling distribution: meaningless at temperature 0
+    with pytest.raises(ValueError, match="temperature > 0"):
+        SamplingParams(temperature=0.0, top_p=0.9)
+    with pytest.raises(ValueError, match="seed"):
+        SamplingParams(temperature=1.0, seed=-1)
+    with pytest.raises(ValueError, match="seed"):
+        SamplingParams(temperature=1.0, seed=1 << 64)
+    with pytest.raises(ValueError, match="seed"):
+        SamplingParams(temperature=1.0, seed=True)
+
+    # the base key IS jax.random.PRNGKey(seed)'s raw data — host-derived
+    # (no device dispatch at submit) — for 32-bit seeds; past 32 bits the
+    # host derivation keeps the high word PRNGKey silently truncates
+    # under the default x64-disabled config, so distinct seeds stay
+    # distinct keys across the whole documented [0, 2^64) range
+    for s in (0, 5, (1 << 31) + 9):
+        np.testing.assert_array_equal(
+            base_key(s), np.asarray(jax.random.key_data(
+                jax.random.PRNGKey(s)), np.uint32).reshape(-1)[-2:])
+    for s in ((1 << 32) + 7, (1 << 63) + 3):
+        np.testing.assert_array_equal(
+            base_key(s),
+            np.asarray([(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], np.uint32))
+    np.testing.assert_array_equal(
+        SamplingParams(temperature=1.0, seed=5).key(), base_key(5))
+
+
+def test_scheduler_submit_rejects_non_params():
+    sched = FIFOScheduler(max_len=32, buckets=(8,))
+    with pytest.raises(ValueError, match="SamplingParams"):
+        sched.submit([1, 2], max_new=4, sampling=(0.7, 0.9))
+    # a validated instance passes through onto the Request
+    req = sched.submit([1, 2], max_new=4,
+                       sampling=SamplingParams(temperature=0.7, seed=3))
+    assert req.sampling.seed == 3 and req.logprobs == []
+
+
+# ----------------------------------------------------------------------
+# greedy limit: temperature == 0 rows == the greedy engine, everywhere
+
+
+def test_greedy_limit_matches_engine_greedy_everywhere():
+    model, params = _model_and_params(seed=1)
+    want, _ = _serve(model, params)                  # engine-default greedy
+    zero = SamplingParams(temperature=0.0, seed=123)  # seed must be inert
+    for kw in ({}, {"decode_ahead": 4}, {"kv_page_size": 8},
+               {"speculative": "ngram", "draft_len": 3},
+               {"speculative": "ngram", "draft_len": 3, "decode_ahead": 4}):
+        got, logps = _serve(model, params, sampling=zero, **kw)
+        assert got == want, kw
+        assert all(len(lp) == len(t) for lp, t in zip(logps, got))
+
+
+def test_logprobs_are_raw_logits_log_softmax():
+    """Every generated token carries log_softmax(RAW logits)[token] — the
+    model's pre-temperature distribution.  Pinned against a reference
+    prefill for the first token, greedy and sampled alike."""
+    model, params = _model_and_params(seed=2)
+    prompt = np.asarray([9, 4, 2], np.int32)
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :3] = prompt
+    _, last = make_prefill(model, 48)(
+        params, jnp.asarray(padded), jnp.asarray([3], np.int32))
+    ref = np.asarray(jax.nn.log_softmax(last, axis=-1))[0]
+
+    for sp in (None, SamplingParams(temperature=1.1, top_p=0.9, seed=7)):
+        toks, logps = _serve(model, params, sampling=sp,
+                             prompts=[prompt], max_new=4)
+        assert len(logps[0]) == len(toks[0]) == 4
+        assert logps[0][0] == pytest.approx(float(ref[toks[0][0]]), abs=1e-5)
+        assert all(lp <= 1e-6 for lp in logps[0])   # log-probs, not probs
+
+
+# ----------------------------------------------------------------------
+# seeded purity: the stream is a function of the seed, not the batching
+
+
+def test_seeded_stream_invariant_across_k_layout_restart():
+    model, params = _model_and_params(seed=3)
+    sp = SamplingParams(temperature=0.8, top_p=0.9, seed=1234)
+    want, want_lp = _serve(model, params, sampling=sp)  # decode_ahead=1
+    for kw in ({"decode_ahead": 4}, {"decode_ahead": 8},
+               {"kv_page_size": 8}, {}):               # {} = restart
+        got, lp = _serve(model, params, sampling=sp, **kw)
+        assert got == want, kw
+        for a, b in zip(lp, want_lp):
+            np.testing.assert_allclose(a, b, atol=1e-5, err_msg=str(kw))
+    # a different seed is a different stream (vocab 16, 8 tokens, 4 reqs:
+    # a full collision would be astronomically unlucky)
+    other, _ = _serve(model, params,
+                      sampling=SamplingParams(temperature=0.8, top_p=0.9,
+                                              seed=4321))
+    assert other != want
+
+
+def test_spec_sampled_replay_token_identical():
+    """At fixed engine config a speculative sampled serve replays
+    token-identically (same seeds -> same accepts -> same residuals).
+    The spec and plain sample PATHS differ by design — only their
+    distributions and the greedy limit coincide."""
+    model, params = _model_and_params(seed=4)
+    mix = [SamplingParams(temperature=0.9, seed=i) for i in range(3)]
+    mix.append(None)                                  # greedy rider
+    kw = dict(speculative="ngram", draft_len=3)
+    a, a_lp = _serve(model, params, sampling=mix, **kw)
+    b, b_lp = _serve(model, params, sampling=mix, **kw)
+    assert a == b and a_lp == b_lp
+    # the greedy rider matches the all-greedy reference in the same batch
+    want, _ = _serve(model, params)
+    assert a[3] == want[3]
+
+
+# ----------------------------------------------------------------------
+# one program family: sampling configs are data, never shapes
+
+
+def test_zero_new_programs_across_sampling_configs():
+    model, params = _model_and_params(seed=5)
+    mixes = [None, SamplingParams(temperature=0.7, top_p=0.9, seed=1),
+             SamplingParams(temperature=1.3, seed=9),
+             SamplingParams(temperature=0.4, top_p=0.3, seed=42)]
+    for kw in ({"decode_ahead": 4},
+               {"speculative": "ngram", "draft_len": 3}):
+        eng = _engine(model, params, **kw)
+        eng.prewarm()
+        before = eng._compile.snapshot()
+        reqs = [eng.submit(np.asarray(p, np.int32), max_new=8, sampling=s)
+                for p, s in zip(PROMPTS, mixes)]
+        eng.run()
+        d = CompileTracker.delta(eng._compile.snapshot(), before)
+        assert d["n_compiled_programs"] == 0, (kw, d)
+        assert all(r.status == "done" for r in reqs)
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# distribution: rejection sampling == target sampling, chi-squared gated
+
+
+def _chi2_gate(counts, p, label):
+    """Pearson chi-squared at the 99.9th percentile, merging categories
+    with expected count < 5 (the classical validity floor) into one bin."""
+    n = counts.sum()
+    # a token outside the target's support (nucleus-filtered out) must
+    # never be emitted at all — that's a correctness bug, not noise
+    assert counts[p == 0].sum() == 0, f"{label}: emitted zero-prob token"
+    counts, p = counts[p > 0], p[p > 0]
+    exp = n * p
+    small = exp < 5.0
+    if small.any():
+        counts = np.concatenate([counts[~small], [counts[small].sum()]])
+        exp = np.concatenate([exp[~small], [exp[small].sum()]])
+    assert exp.min() >= 1.0, f"{label}: degenerate target distribution"
+    chi2 = float((((counts - exp) ** 2) / exp).sum())
+    dof = len(counts) - 1
+    assert chi2 < CHI2_999[dof], (
+        f"{label}: chi2 {chi2:.2f} >= {CHI2_999[dof]} (dof {dof}) over "
+        f"{int(n)} draws — emitted distribution != target")
+
+
+def test_verify_rejection_sampling_matches_target_distribution():
+    """>= 10k draws through the speculative verify on a vocab-8 model:
+    the first emitted token's empirical distribution must match the
+    tempered/nucleus target — whether the draft is the mode (mostly
+    accepted) or the least likely token (mostly rejected -> residual)."""
+    model, params = _model_and_params(seed=6, num_classes=8)
+    B, reps, max_len = 512, 20, 16
+    prompt = np.tile(np.asarray([[3, 5, 1, 6]], np.int32), (B, 1))
+    prefill = make_prefill(model, max_len)
+    cache0, last = prefill(params, jnp.asarray(prompt))
+    pend = jnp.argmax(last, -1).astype(jnp.int32)     # pending first token
+    # reference logits at the position the verify's lane 0 samples
+    _, logits0 = make_decode_step(model, max_len)(params, cache0, pend)
+    verify = jax.jit(functools.partial(
+        _verify_sample_core, model, max_len=max_len, top_k=0, pad_id=0))
+
+    for temp, topp, pick, label in ((1.2, 0.0, "hi", "plain/mode-draft"),
+                                    (0.9, 0.85, "lo", "nucleus/worst-draft")):
+        temps = jnp.full((B,), temp, jnp.float32)
+        topps = jnp.full((B,), topp, jnp.float32)
+        p = np.asarray(jax.nn.softmax(
+            _tempered_rows(logits0[:1], temps[:1], topps[:1], 0)))[0]
+        draft = int(np.argmax(p) if pick == "hi" else np.argmin(p))
+        chunk = np.zeros((B, 2), np.int32)
+        chunk[:, 0] = np.asarray(pend)
+        chunk[:, 1] = draft
+        counts = np.zeros(p.size)
+        for rep in range(reps):
+            seeds = range(rep * B, (rep + 1) * B)
+            keys = jnp.asarray(np.stack([base_key(s) for s in seeds]))
+            _, toks, logps, acc, _ = verify(
+                params, cache0, jnp.asarray(chunk),
+                jnp.ones((B,), jnp.int32), jnp.ones((B,), bool),
+                temps, topps, keys, jnp.zeros((B,), jnp.int32))
+            np.add.at(counts, np.asarray(toks)[:, 0], 1)
+        assert counts.sum() == B * reps >= 10_000
+        _chi2_gate(counts, p, label)
+
+
+# ----------------------------------------------------------------------
+# failover: seeded replay is token-identical with exactly-once streaming
+
+
+def test_router_failover_replays_sampled_exactly_once():
+    """Chaos kills one replica mid-wave; its sampled collateral re-decodes
+    on a survivor.  Seeded purity makes the replay token-identical, and
+    the delivered high-water mark suppresses the replayed prefix — each
+    stream sees every token exactly once."""
+    model, params = _model_and_params(seed=7)
+    mix = [SamplingParams(temperature=0.9, top_p=0.9, seed=i * 7 + 1)
+           for i in range(len(PROMPTS) - 1)] + [None]
+
+    def factory(tid, chaos=None):
+        return InferenceEngine(
+            model, params, slots=2, max_len=16,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=16),
+            trace_tid=tid, chaos=chaos, stall_timeout_s=None)
+
+    # fault-free reference: one engine, same sampling
+    eng = factory(0)
+    want = [eng.submit(np.asarray(p, np.int32), max_new=6, sampling=s)
+            for p, s in zip(PROMPTS, mix)]
+    eng.run()
+    eng.close()
+    want_toks = [list(r.generated) for r in want]
+
+    inj = FaultInjector(FaultPlan(faults=(
+        FaultSpec(site="serving-step", kind="transient", at=(1,)),)))
+    streams: dict[int, list[int]] = {}
+    r = Router(lambda tid: factory(tid, chaos=inj), 2)
+    rrs = [r.submit(p, max_new=6, sampling=s,
+                    callback=lambda rr, tok: streams.setdefault(
+                        rr.id, []).append(int(tok)))
+           for p, s in zip(PROMPTS, mix)]
+    r.run_until_done()
+    assert [list(rr.generated) for rr in rrs] == want_toks
+    assert all(rr.status == "done" for rr in rrs)
+    assert r.failovers == 1
+    moved = [rr for rr in rrs if rr.redispatches]
+    assert moved                                     # someone was displaced
+    for rr in rrs:                                   # exactly-once delivery
+        assert streams.get(rr.id, []) == list(rr.generated)
+        assert len(rr.logprobs) == len(rr.generated)
+    # the rollup carries the sampled-traffic accounting (attempts of the
+    # displaced sampled requests count too — they are engine records)
+    summ = r.summary()
+    assert summ["n_sampled_requests"] >= len(PROMPTS) - 1
+    assert summ["mean_temperature"] == pytest.approx(0.9, abs=1e-4)
+    assert summ["logprob_tokens"] > 0 and summ["nll_p50"] is not None
+    r.close()
+
+
+# ----------------------------------------------------------------------
+# stats: schema stays stable, ratios null-not-NaN
+
+
+def test_stats_sampling_fields_and_merge():
+    model, params = _model_and_params(seed=8)
+    eng = _engine(model, params)
+    sp = SamplingParams(temperature=0.6, seed=11)
+    reqs = [eng.submit(np.asarray(p, np.int32), max_new=5, sampling=s)
+            for p, s in zip(PROMPTS[:2], (sp, None))]
+    eng.run()
+    s = eng.stats.summary()
+    assert s["n_sampled_requests"] == 1
+    assert s["mean_temperature"] == pytest.approx(0.6, abs=1e-4)
+    assert s["logprob_tokens"] == sum(len(r.generated) for r in reqs)
+    assert s["nll_p50"] is not None and s["nll_p50"] >= 0
+    eng.close()
+
+    # empty stats: every sampling figure is null, never NaN, and the
+    # merged rollup re-derives means from summed counters
+    empty = ServingStats(slots=1)
+    es = empty.summary()
+    assert es["n_sampled_requests"] == 0
+    assert es["mean_temperature"] is None and es["nll_p50"] is None
+    merged = ServingStats.merge([eng.stats, empty])
+    assert merged["n_sampled_requests"] == 1
+    assert merged["mean_temperature"] == pytest.approx(0.6, abs=1e-4)
+    assert merged["nll_p50"] is not None

@@ -264,9 +264,11 @@ def test_speculative_validation():
         _engine(model, params, speculative="tree")
     with pytest.raises(ValueError, match="draft_len"):
         _engine(model, params, speculative="ngram", draft_len=0)
-    with pytest.raises(ValueError, match="GREEDY"):
-        _engine(model, params, speculative="ngram", temperature=0.7,
-                rng=jax.random.PRNGKey(0))
+    # ISSUE 13 lifted the old spec+sampling refusal: the verify window
+    # accepts drafts by rejection sampling, so this must now construct
+    eng = _engine(model, params, speculative="ngram", temperature=0.7,
+                  rng=jax.random.PRNGKey(0))
+    eng.close()
     wmodel, wparams = _model_and_params(window=8)
     with pytest.raises(ValueError, match="sliding-window"):
         _engine(wmodel, wparams, speculative="ngram")
