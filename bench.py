@@ -524,6 +524,53 @@ def main() -> None:
             sampling_gate_rc = 1
             print(f"bench: sampling phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 5f — chunked prefill (ISSUE 14): InferenceEngine(
+    # prefill_chunk=C) measured by scripts/bench_serving.py
+    # --chunked-only in a SUBPROCESS on the CPU backend, four gates:
+    # decode TPOT p99 flat (<= 1.15x a no-long-prompt control) while
+    # prompts past every bucket admit chunk-by-chunk, short-request TTFT
+    # p99 held, token parity vs a whole-prompt engine, and the chunk
+    # program family census-pinned (chunked_repeat = ZERO compiles).
+    # Skippable (DTM_BENCH_SKIP_CHUNKED); a gate breach FAILS the bench
+    # run (exit 3) after the record prints — a decode stall on long
+    # admissions is the regression chunking exists to prevent.
+    chunked = None
+    chunked_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_CHUNKED"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_serving.py"),
+                 "--chunked-only"],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "chunked_prefill":
+                    chunked = rec
+            if chunked is None or out.returncode != 0:
+                chunked_gate_rc = out.returncode or 1
+                print(
+                    f"bench: chunked_prefill subprocess "
+                    f"{'produced no record' if chunked is None else 'FAILED (TPOT/TTFT/parity/census gate)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            chunked_gate_rc = 1
+            print(f"bench: chunked_prefill phase failed: {e!r}", file=sys.stderr)
+
     # Phase 6 — the chaos soak (ISSUE 3): seeded multi-fault plans against
     # training (torn checkpoint write, NaN step, checkpoint-read + data-
     # batch I/O faults -> bit-identical recovery) and serving (poisoned
@@ -796,6 +843,10 @@ def main() -> None:
         result["sampling"] = {
             k: v for k, v in sampling.items() if k != "metric"
         }
+    if chunked is not None:
+        result["chunked_prefill"] = {
+            k: v for k, v in chunked.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -809,7 +860,7 @@ def main() -> None:
     # arithmetic) fail the RUN, not just their block — after the record
     # prints so the numbers are never lost with the verdict
     if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
-            or sampling_gate_rc):
+            or sampling_gate_rc or chunked_gate_rc):
         import sys
 
         sys.exit(3)

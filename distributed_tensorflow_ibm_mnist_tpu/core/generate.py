@@ -77,8 +77,9 @@ acceptance is exact for greedy decoding and would bias any sampled
 distribution.
 
 ISSUE 13 adds the SAMPLING-aware siblings the serving engine composes:
-:func:`_pick_rows` (argmax / temperature / top-p selected by per-row
-*data* planes, never by program shape), :func:`_sample_window_core`
+:func:`_pick_rows` (argmax / temperature / top-p / top-k — ISSUE 14 —
+selected by per-row *data* planes, never by program shape),
+:func:`_sample_window_core`
 (the decode-ahead scan with per-row fold-in PRNG keys and a position
 counter threaded through the carry, emitting per-token logprobs), and
 :func:`_verify_sample_core` (speculative REJECTION sampling: accept
@@ -86,8 +87,8 @@ draft ``d`` with prob ``min(1, p_target(d)/q_draft(d))`` — ``p(d)`` for
 the point-mass n-gram drafter — and resample the residual on reject,
 which preserves the target distribution exactly; the ``temperature=0``
 rows reduce bit-for-bit to the argmax match).  One program serves every
-``(temperature, top_p, seed)`` mix, so distinct per-request configs
-never recompile.
+``(temperature, top_p, top_k, seed)`` mix, so distinct per-request
+configs never recompile.
 """
 
 from __future__ import annotations
@@ -487,26 +488,42 @@ def _filter_topp_rows(logits, top_ps):
     return jnp.where(nucleus[:, None], filtered, logits)
 
 
-def _tempered_rows(logits, temps, topps, top_k: int):
+def _filter_topk_rows(logits, top_ks):
+    """Per-row top-k filter with ``top_k`` as DATA — the plane-driven
+    sibling of :func:`_filter_logits`'s static ``top_k`` branch (same keep
+    rule: the k highest logits survive, ties at the k-th value included).
+    ``top_ks`` is (B,) int32; rows with ``top_k <= 0`` or ``>= vocab``
+    pass through unfiltered, so greedy and unfiltered-sampling rows ride
+    the same program as top-k rows."""
+    neg = jnp.finfo(logits.dtype).min
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k = jnp.clip(top_ks, 1, vocab).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    filtered = jnp.where(logits < kth, neg, logits)
+    on = (top_ks > 0) & (top_ks < vocab)
+    return jnp.where(on[:, None], filtered, logits)
+
+
+def _tempered_rows(logits, temps, topps, topks):
     """The per-row SAMPLING distribution as filtered logits: temperature
     scaling (before the filters, matching :func:`make_generator`'s static
-    order), optional static ``top_k``, then the data-driven nucleus
-    filter.  Rows with ``temps <= 0`` get a well-defined placeholder
-    (divide by 1) — their output is overridden by argmax in
+    order), then the data-driven top-k and nucleus filters (top-k first,
+    like the static path).  Rows with ``temps <= 0`` get a well-defined
+    placeholder (divide by 1) — their output is overridden by argmax in
     :func:`_pick_rows`, the placeholder just keeps the math NaN-free."""
     safe_t = jnp.where(temps > 0.0, temps, 1.0)[:, None]
     scaled = logits / safe_t
-    if top_k > 0:
-        scaled = _filter_logits(scaled, top_k, 0.0)
+    scaled = _filter_topk_rows(scaled, jnp.asarray(topks, jnp.int32))
     return _filter_topp_rows(scaled, topps)
 
 
-def _pick_rows(logits, temps, topps, keys, top_k: int = 0):
+def _pick_rows(logits, temps, topps, topks, keys):
     """Data-driven per-row pick: (B, V) logits + per-row ``temps`` /
-    ``topps`` / already-fold-in'd ``keys`` (B, 2) uint32 planes ->
-    ``((B,) int32 token, (B,) float32 logprob)``.  Rows with
+    ``topps`` / ``topks`` / already-fold-in'd ``keys`` (B, 2) uint32
+    planes -> ``((B,) int32 token, (B,) float32 logprob)``.  Rows with
     ``temps <= 0`` take argmax (greedy) — selected by ``where`` on the
-    DATA, so every (temperature, top_p) mix shares one program.
+    DATA, so every (temperature, top_p, top_k) mix shares one program.
 
     The logprob is always ``log_softmax`` of the RAW logits at the
     emitted token — the model's own distribution, before temperature or
@@ -514,7 +531,7 @@ def _pick_rows(logits, temps, topps, keys, top_k: int = 0):
     sampling configs and greedy requests report calibrated confidences.
     """
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    filtered = _tempered_rows(logits, temps, topps, top_k)
+    filtered = _tempered_rows(logits, temps, topps, topks)
     sampled = jax.vmap(
         lambda l, k: jax.random.categorical(k, l))(filtered, keys)
     tok = jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
@@ -524,13 +541,14 @@ def _pick_rows(logits, temps, topps, keys, top_k: int = 0):
 
 
 def _sample_window_core(model, params, cache, tok, active, temps, topps,
-                        keys, pos, window: int, max_len: int, ragged: bool,
-                        top_k: int, pad_id: int):
+                        topks, keys, pos, window: int, max_len: int,
+                        ragged: bool, pad_id: int):
     """The sampling-aware decode-ahead window (ISSUE 13): ``window`` fused
     decode+pick steps as ONE ``lax.scan``, with the per-row sampling
     planes as runtime DATA and the PRNG threaded through the carry.
 
-    ``temps``/``topps`` are (B,) float32, ``keys`` (B, 2) uint32 BASE keys
+    ``temps``/``topps`` are (B,) float32, ``topks`` (B,) int32, ``keys``
+    (B, 2) uint32 BASE keys
     (one per request, a pure function of its seed), ``pos`` (B,) int32 the
     per-row count of already-generated tokens.  The token at generated
     index ``n`` is picked with ``fold_in(base_key, n)``, and ``pos``
@@ -544,6 +562,7 @@ def _sample_window_core(model, params, cache, tok, active, temps, topps,
     pad = jnp.asarray(pad_id, jnp.int32)
     temps = jnp.asarray(temps, jnp.float32)
     topps = jnp.asarray(topps, jnp.float32)
+    topks = jnp.asarray(topks, jnp.int32)
     keys = jnp.asarray(keys, jnp.uint32)
     step = active.astype(jnp.int32)
 
@@ -552,7 +571,7 @@ def _sample_window_core(model, params, cache, tok, active, temps, topps,
         cache, logits = _decode_step_core(model, params, cache, tok,
                                           max_len, ragged)
         step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
-        nxt, logp = _pick_rows(logits, temps, topps, step_keys, top_k)
+        nxt, logp = _pick_rows(logits, temps, topps, topks, step_keys)
         nxt = jnp.where(active, nxt, pad)
         logp = jnp.where(active, logp, 0.0)
         return (cache, nxt, pos + step), (nxt, logp)
@@ -564,7 +583,7 @@ def _sample_window_core(model, params, cache, tok, active, temps, topps,
 
 
 def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
-                        temps, topps, keys, pos, max_len: int, top_k: int,
+                        temps, topps, topks, keys, pos, max_len: int,
                         pad_id: int):
     """Speculative verify with REJECTION SAMPLING (ISSUE 13) — the
     sampling-aware sibling of :func:`_verify_window_core`, sharing its
@@ -601,6 +620,7 @@ def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
     pad = jnp.asarray(pad_id, jnp.int32)
     temps = jnp.asarray(temps, jnp.float32)
     topps = jnp.asarray(topps, jnp.float32)
+    topks = jnp.asarray(topks, jnp.int32)
     keys = jnp.asarray(keys, jnp.uint32)
     pos = jnp.asarray(pos, jnp.int32)
     idx0 = _cache_cursor(cache)
@@ -618,7 +638,8 @@ def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
     # the per-position filtered target distribution, flattened to rows
     flat = logits.reshape(b * k, -1)
     filt = _tempered_rows(flat, jnp.repeat(temps, k),
-                          jnp.repeat(topps, k), top_k).reshape(b, k, -1)
+                          jnp.repeat(topps, k),
+                          jnp.repeat(topks, k)).reshape(b, k, -1)
     probs = jax.nn.softmax(filt, axis=-1)                        # (B, k, V)
 
     # generated index per position and its key family (flattened B*k)

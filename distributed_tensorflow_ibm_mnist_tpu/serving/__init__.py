@@ -25,11 +25,19 @@ Mesh-TensorFlow separation of device program from execution driver
   ``InferenceEngine.prewarm()`` / ``Router.prewarm()``
   compile the full program family in the launch path (ROADMAP 5a)
 * :class:`~.sampling.SamplingParams` — per-request
-  ``(temperature, top_p, seed)`` sampling (ISSUE 13): per-slot data
+  ``(temperature, top_p, top_k, seed)`` sampling (ISSUE 13; ``top_k``
+  per-request since ISSUE 14): per-slot data
   planes into ONE compiled window program, position-keyed PRNG (a
   request's stream is a pure function of its seed — restarts and
   failover replays are token-identical), per-token raw-logits logprobs
   on every :class:`~.scheduler.Request`
+* chunked prefill (ISSUE 14, ``InferenceEngine(prefill_chunk=C)``): any
+  admitted prompt — past every bucket, up to ``max_len - max_new`` —
+  prefills as C-token chunks through ONE paged ``extend[b{C}]`` program,
+  one chunk per engine iteration at the prefill-overlap seam, so
+  admission costs the decoding slots at most one chunk of latency; the
+  slot holds a transient ``PREFILLING`` state until its last chunk lands
+  (docs/SERVING.md §Chunked prefill)
 * :class:`~.stats.ServingStats` — TTFT/latency percentiles, tokens/sec,
   slot occupancy, decode-ahead window/waste accounting, prefix hit rate,
   compile accounting (``n_compiled_programs`` — ISSUE 6), emitted through

@@ -46,10 +46,10 @@ PRNG key for the token at generated index n is ``fold_in(base_key, n)``
 (serving/sampling.py): the index, not the window phase, owns the key, so
 decode-ahead width never changes a request's stream.
 
-Per-request sampling (ISSUE 13): a request may carry
-``SamplingParams(temperature, top_p, seed)`` (serving/sampling.py); the
-engine keeps per-slot (slots,) temperature/top-p planes and a (slots, 2)
-base-key plane as runtime DATA into ONE compiled window program
+Per-request sampling (ISSUE 13, top-k ISSUE 14): a request may carry
+``SamplingParams(temperature, top_p, top_k, seed)`` (serving/sampling.py);
+the engine keeps per-slot (slots,) temperature/top-p/top-k planes and a
+(slots, 2) base-key plane as runtime DATA into ONE compiled window program
 (core/generate.py ``_sample_window_core``) — greedy and sampled rows ride
 the same program, so the compile census is invariant across sampling
 mixes.  Each generated token's raw-logits logprob comes back with the
@@ -106,6 +106,29 @@ The chaos contract is unchanged: one
 or verifies.  ``ServingStats`` gains drafted/accepted/corrected counters,
 ``accept_rate``, and ``useful_tokens_per_window``; each request's trace
 track gains per-window draft/verify/accept spans.
+
+Chunked prefill (ISSUE 14, ``prefill_chunk=C``): whole-prompt prefill —
+bucketed OR radix-suffix — freezes every co-resident request's decode for
+the full prompt duration, and long prompts need a matching bucket.  With
+``prefill_chunk=C`` (paged KV required) admission allocates the request's
+pages up front but dispatches NO prefill; the prompt then advances in
+fixed (1, C)-token chunks through the paged suffix-extend program — ONE
+``extend[b{C}]`` program for every chunk of every prompt, so the census
+stays pinned and prompts up to ``max_len - max_new`` need no bucket.  One
+chunk dispatches per engine iteration at the prefill-overlap seam
+(between the window dispatch and its blocking readback), so the decode
+latency any admission adds is bounded by one chunk, not one prompt.  The
+partially-prefilled slot holds a transient PREFILLING state: occupied
+(its pages are real) but inactive in every window — its decode writes
+are garbage the chunk cursor overwrites — and invisible to drafting and
+the token loop.  A radix partial hit lands chunking AT the divergence
+page (``done`` starts at the matched-page boundary); the finished prompt
+donates its pages back to the trie exactly like whole-prompt admission.
+Chaos contract unchanged: one ``serving-admit`` event per admission
+attempt (a pool-stall retry does not re-fire), chunk dispatches ride the
+window's ``serving-step`` with NO events of their own.  The prefix cache
+(whole-row store) is refused under chunking — the radix trie is the
+prefix-sharing mechanism.
 
 Launch-path prewarm (ROADMAP item 5a, :meth:`InferenceEngine.prewarm`):
 every program above compiles lazily at first use, so the first requests
@@ -194,6 +217,12 @@ from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
 # runs at LANDING, against the live trie/pool state at that moment
 _RADIX_PREFILL = object()
 
+# sentinel "prefilled" payload a chunked admission parks with when the
+# page pool is momentarily dry (ISSUE 14): nothing was prefilled — the
+# retry re-runs _chunk_admit from the allocation, skipping the already-
+# fired serving-admit chaos event (one event per admission attempt)
+_CHUNK_STALL = object()
+
 
 class EngineStalled(RuntimeError):
     """The watchdog verdict: no token progress across ALL slots within
@@ -234,6 +263,12 @@ class InferenceEngine:
     prefix skips its prefill compute (only the suffix runs, via the extend
     program) and occupies ZERO extra pages.  Greedy paged output is
     token-identical to the dense engine for every ``decode_ahead``.
+    ``prefill_chunk=C`` (paged only) replaces whole-prompt prefill with
+    interleaved C-token chunks through the one ``extend[b{C}]`` program —
+    bounded decode stalls, prompts up to ``max_len - max_new`` with no
+    matching bucket, a transient PREFILLING slot state (see module docs);
+    exclusive with ``prefix_cache_bytes`` (the radix trie is the sharing
+    mechanism under chunking).
 
     ``tp=N`` shards the WHOLE program family over an N-chip ``("tp",)``
     mesh (parallel/tensor_parallel.py ``serving_mesh``): weights
@@ -255,9 +290,9 @@ class InferenceEngine:
     ``SamplingParams`` (greedy at ``temperature=0``; ``rng`` required
     otherwise — its key data seeds the default base key).  A request's
     own ``submit(..., sampling=SamplingParams(...))`` overrides the
-    default per slot; ``top_k`` stays an engine-level static knob (it
-    shapes the compiled filter), while temperature/top_p/seed are
-    per-slot runtime data.
+    default per slot — temperature/top_p/top_k/seed are all per-slot
+    runtime data planes into the one compiled window (ISSUE 14 made
+    top-k a data plane like the rest).
     ``tracer=`` (utils/tracing.Tracer) records a span tree per request and
     per decode window (nil-guarded — zero tracing instructions when None);
     construct it with the same ``clock`` as the engine so span durations
@@ -285,6 +320,7 @@ class InferenceEngine:
                  prefix_cache_bytes: int = 0,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  radix_cache: bool | None = None,
+                 prefill_chunk: int = 0,
                  tp: int = 1, tp_devices=None,
                  quant: str | None = None,
                  eos_id: int | None = None, pad_id: int = 0,
@@ -348,6 +384,27 @@ class InferenceEngine:
             raise ValueError(
                 "radix_cache shares whole KV PAGES between requests — it "
                 "needs the paged cache (kv_page_size > 0)")
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0 (0 = whole-prompt bucketed "
+                f"prefill), got {prefill_chunk}")
+        if prefill_chunk:
+            if not kv_page_size:
+                raise ValueError(
+                    "prefill_chunk runs prompts through the paged suffix-"
+                    "extend program — it needs the paged cache "
+                    "(kv_page_size > 0)")
+            if prefill_chunk > max_len:
+                raise ValueError(
+                    f"prefill_chunk ({prefill_chunk}) cannot exceed max_len "
+                    f"({max_len}) — a chunk is at most one slot's span")
+            if prefix_cache_bytes > 0:
+                raise ValueError(
+                    "prefill_chunk does not compose with the dense prefix "
+                    "cache (prefix_cache_bytes > 0): chunked admission "
+                    "never produces the bucketed row the cache stores — "
+                    "the radix trie is the prefix-sharing mechanism under "
+                    "chunking (radix_cache, on by default when paged)")
         if kv_page_size:
             if max_len % kv_page_size:
                 raise ValueError(
@@ -450,12 +507,14 @@ class InferenceEngine:
         # `is None`, NOT `or`: FIFOScheduler defines __len__, so an EMPTY
         # custom scheduler is falsy and `scheduler or default` would
         # silently discard it (with its buckets/bounds/clock)
+        self._prefill_chunk = int(prefill_chunk)
         if scheduler is None:
             scheduler = FIFOScheduler(
                 max_len=max_len,
                 buckets=buckets if buckets is not None else
                 tuple(b for b in (16, 32, 64, 128) if b <= max_len) or (max_len,),
-                clock=clock, tracer=tracer)
+                clock=clock, tracer=tracer,
+                chunked_prefill=bool(prefill_chunk))
         elif buckets is not None:
             # the compiled prefill shapes are derived from the SCHEDULER's
             # buckets (one source of truth) — an engine-level buckets= that
@@ -468,6 +527,15 @@ class InferenceEngine:
                     f"{scheduler.buckets} — the prefill programs compile at "
                     "the scheduler's shapes, so a mismatch would admit "
                     "prompts the engine never compiled for")
+        # chunking lifts the bucket bound at SUBMIT (scheduler) and honors
+        # it at ADMISSION (engine) — the two sides must agree, like buckets
+        if getattr(scheduler, "chunked_prefill", False) and not prefill_chunk:
+            raise ValueError(
+                "scheduler.chunked_prefill is set but the engine has no "
+                "prefill_chunk= — the scheduler would admit prompts past "
+                "the largest bucket that the engine cannot prefill")
+        if prefill_chunk:
+            scheduler.chunked_prefill = True
         self.scheduler = scheduler
         # ONE tracer serves a request's whole span tree: the scheduler
         # opens it (submit/queue), the engine continues it (admit/decode/
@@ -570,18 +638,19 @@ class InferenceEngine:
         top_k_ = int(top_k)
         window_ = self.decode_ahead
 
-        def _window_impl(params, cache, tok, active, temps, topps, keys,
-                         pos):
+        def _window_impl(params, cache, tok, active, temps, topps, topks,
+                         keys, pos):
             # decode_ahead fused decode+pick steps as ONE dispatch
             # (core/generate.py _sample_window_core): the host loop pays
             # per-iteration dispatch latency and ONE blocking readback per
-            # WINDOW instead of per token.  temperature/top_p/base-key/
-            # position ride as per-slot DATA planes, so every sampling mix
-            # (greedy included) is this ONE program — the census never
-            # moves across distinct (temperature, top_p, seed) configs.
+            # WINDOW instead of per token.  temperature/top_p/top_k/base-
+            # key/position ride as per-slot DATA planes, so every sampling
+            # mix (greedy included) is this ONE program — the census never
+            # moves across distinct (temperature, top_p, top_k, seed)
+            # configs.
             cache, blk, logps, last, pos = _sample_window_core(
                 decode_model, params, cache, tok, active, temps, topps,
-                keys, pos, window_, max_len, True, top_k_, pad_id_)
+                topks, keys, pos, window_, max_len, True, pad_id_)
             return _pin(cache), blk, logps, last, pos
 
         self._window = jax.jit(_window_impl, donate_argnums=(1,))
@@ -597,10 +666,10 @@ class InferenceEngine:
             # happens on the host between windows, which a fused k-step
             # scan could never pause for.
             def _verify_impl(params, cache, chunk, draft_lens, active,
-                             temps, topps, keys, pos):
+                             temps, topps, topks, keys, pos):
                 cache, *rest = _verify_sample_core(
                     decode_model, params, cache, chunk, draft_lens, active,
-                    temps, topps, keys, pos, max_len, top_k_, pad_id_)
+                    temps, topps, topks, keys, pos, max_len, pad_id_)
                 return (_pin(cache), *rest)
 
             self._verify = jax.jit(_verify_impl, donate_argnums=(1,))
@@ -683,6 +752,13 @@ class InferenceEngine:
             self._slot_alloc = [None] * slots
             self._deferred_free = []
         self._slot_req: list[Request | None] = [None] * slots
+        # chunked-prefill progress per slot (ISSUE 14): None for slots in
+        # normal decode; a dict {"done", "total", "bt", "bt_dev", "last",
+        # "t0"} while the slot is PREFILLING — occupied (its pages are
+        # allocated, its request is resident) but EXCLUDED from the decode
+        # window's active mask until the last chunk lands and the first
+        # token is picked
+        self._slot_prefill: list[dict | None] = [None] * slots
         self._slot_tok = np.full((slots,), self.pad_id, np.int32)
         self._tok_dev = None  # device copy of _slot_tok; None = stale
         self._active_dev = None  # device (slots,) bool mask; None = stale
@@ -693,8 +769,10 @@ class InferenceEngine:
         # plane rows are masked by `active`, so no invalidation there).
         self._slot_temp = np.full((slots,), self._default_temp, np.float32)
         self._slot_topp = np.full((slots,), self._default_topp, np.float32)
+        self._slot_topk = np.full((slots,), self._top_k, np.int32)
         self._slot_key = np.tile(self._default_key, (slots, 1))
-        self._planes_dev = None  # (temps, topps, keys) on device; None = stale
+        # (temps, topps, topks, keys) on device; None = stale
+        self._planes_dev = None
         # device (slots,) int32 count of already-generated tokens per slot
         # — the PRNG position plane.  Plain windows return the advanced
         # plane (carried like _tok_dev); spec windows re-upload fresh each
@@ -859,18 +937,28 @@ class InferenceEngine:
         return sum(r is not None for r in self._slot_req)
 
     @property
+    def _decoding(self) -> int:
+        """Slots holding a request that is past prefill — PREFILLING
+        slots are occupied (pages held, request resident) but excluded
+        from the decode window until their last chunk lands."""
+        return sum(r is not None and p is None
+                   for r, p in zip(self._slot_req, self._slot_prefill))
+
+    @property
     def has_work(self) -> bool:
         return (self.occupied > 0 or len(self.scheduler) > 0
                 or len(self._pending) > 0)
 
     def _req_sampling(self, req: Request):
-        """``(temperature, top_p, base_key)`` resolved for ``req`` — its
-        own :class:`SamplingParams`, or the engine's construction-time
-        defaults for requests submitted without one."""
+        """``(temperature, top_p, top_k, base_key)`` resolved for ``req``
+        — its own :class:`SamplingParams`, or the engine's
+        construction-time defaults for requests submitted without one."""
         s = req.sampling
         if s is None:
-            return self._default_temp, self._default_topp, self._default_key
-        return float(s.temperature), float(s.top_p), s.key()
+            return (self._default_temp, self._default_topp, self._top_k,
+                    self._default_key)
+        return (float(s.temperature), float(s.top_p), int(s.top_k),
+                s.key())
 
     def _first_pick(self, req: Request, logits):
         """Pick ``req``'s FIRST token (generated index 0) from the
@@ -879,13 +967,14 @@ class InferenceEngine:
         program for a fresh prefill, a prefix-cache hit, and a paged
         radix-extend landing, so hit/miss first tokens are bit-identical.
         Returns ``(token, logprob)`` as host scalars."""
-        temp, topp, key = self._req_sampling(req)
+        temp, topp, topk, key = self._req_sampling(req)
         with self._compile.site("first_pick"):
             tok, logp = first_pick(
                 logits, self._dev(np.array([temp], np.float32)),
                 self._dev(np.array([topp], np.float32)),
+                self._dev(np.array([topk], np.int32)),
                 self._dev(key[None, :].astype(np.uint32)),
-                self._dev(np.zeros((1,), np.int32)), top_k=self._top_k)
+                self._dev(np.zeros((1,), np.int32)))
         return int(tok[0]), float(logp[0])
 
     # ------------------------------------------------------------------
@@ -943,6 +1032,7 @@ class InferenceEngine:
         if self._telemetry is not None and status == "done":
             self._telemetry.observe("latency_s", now - req.submit_t)
         self._slot_req[slot] = None
+        self._slot_prefill[slot] = None  # a PREFILLING slot can be swept
         self._release_slot_alloc(slot)  # paged: queue its pages for release
         self._active_dev = None  # occupancy changed; next window re-freezes
         self._tr_close(req, status=status, slot=slot, waste_steps=waste,
@@ -1173,6 +1263,12 @@ class InferenceEngine:
         request that retired at admission (its prefilled row would
         otherwise linger under an idle slot).
         """
+        if self._prefill_chunk:
+            # chunked admission (ISSUE 14): allocate the page span and
+            # park the slot in the PREFILLING state — chunks run one per
+            # engine iteration, never a whole-prompt prefill here
+            return self._chunk_admit(req, slot, now,
+                                     retry=prefilled is not None)
         inserted = False
         # inline admissions open their "admit" phase here; overlap-prefilled
         # requests opened it back at pop (in _overlap_prefill), so their
@@ -1237,9 +1333,10 @@ class InferenceEngine:
             return inserted
         self._slot_req[slot] = req
         self._slot_tok[slot] = first
-        temp, topp, key = self._req_sampling(req)
+        temp, topp, topk, key = self._req_sampling(req)
         self._slot_temp[slot] = temp
         self._slot_topp[slot] = topp
+        self._slot_topk[slot] = topk
         self._slot_key[slot] = key
         self._tok_dev = None  # host mirror changed; re-upload before decode
         self._active_dev = None
@@ -1257,6 +1354,228 @@ class InferenceEngine:
         if len(req.generated) >= req.max_new:
             return "done"
         return None
+
+    # ------------------------------------------------------------------
+    # chunked prefill (ISSUE 14): admission holds a slot in the
+    # PREFILLING state while fixed-size prompt chunks run one per engine
+    # iteration through the paged suffix-extend program — the decode
+    # latency cost of admitting ANY prompt is bounded by one chunk
+
+    def _chunk_admit(self, req: Request, slot: int, now: float,
+                     retry: bool = False):
+        """Admit ``req`` into ``slot`` in the PREFILLING state: fire the
+        one ``serving-admit`` chaos event (skipped on a stall ``retry`` —
+        one event per admission ATTEMPT, exactly like the whole-prompt
+        path), take the radix match (a partial hit resumes chunking at
+        the divergence page), allocate the full page span, and build the
+        host-side chunk record.  No chunk is dispatched here — the first
+        runs at the next :meth:`_chunk_tick`.  Returns the same protocol
+        as :meth:`_admit`: ``("stall", _CHUNK_STALL)`` when the pool is
+        momentarily dry (caller re-parks), True/False for
+        needs-reset-without-occupancy, with ``self._slot_req[slot]`` set
+        on success.
+
+        The slot's block table is NOT installed here: a reset pending
+        from the previous tenant stays pending (garbage decode writes
+        land in the trash page), and every chunk's extend call installs
+        the real block table itself before writing."""
+        if req.trace is not None and req.trace.get("phase") is None:
+            self._tr_phase(req, "admit", slot=slot, chunked=True)
+        try:
+            if not retry and self._chaos is not None:
+                from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+                    ChaosFault,
+                )
+
+                self._chaos.raise_if_fired("serving-admit", ChaosFault)
+        except Exception as e:
+            self._fail(req, e, self.clock())
+            return False
+        ps = self._page_size
+        n_tok = int(req.tokens.size)
+        path: list = []
+        m_tok = 0
+        if self._radix is not None:
+            path, matched = self._radix.match(req.tokens)
+            m_tok = self._usable_radix_tokens(req, matched)
+            path = path[: m_tok // ps]
+            m_tok = len(path) * ps
+        m_blocks = len(path)
+        if m_blocks:
+            # pin the matched pages before any allocation could evict them
+            self._radix.acquire(path)
+        total = pages_needed(n_tok + req.max_new, ps)
+        private = self._alloc_pages(total - m_blocks)
+        if private is None:
+            if m_blocks:
+                self._radix.release(path)
+            return ("stall", _CHUNK_STALL)
+        self._slot_alloc[slot] = [list(private), list(path)]
+        bt_row = np.zeros((self.max_len // ps,), np.int32)  # rest = TRASH
+        for j, node in enumerate(path):
+            bt_row[j] = node.page
+        for j, page in enumerate(private):
+            bt_row[m_blocks + j] = page
+        req.pages = total
+        req.admit_t = now
+        req.status = "prefilling"
+        self._slot_req[slot] = req
+        self._slot_prefill[slot] = {
+            "done": m_tok, "m_blocks": m_blocks, "path": path,
+            "bt": bt_row, "bt_dev": self._dev(bt_row), "last": None,
+            "t0": now,
+        }
+        self._active_dev = None  # occupancy changed; the slot joins the
+        # window INACTIVE until its last chunk lands and first_pick runs
+        if m_blocks:
+            self.stats.radix(True, tokens=m_tok)
+            self._radix.record(True, tokens=m_tok)
+            req.radix_tokens = m_tok
+            self._tr_instant(req, "radix_hit", blocks=m_blocks,
+                             tokens=m_tok)
+        elif self._radix is not None:
+            self.stats.radix(False)
+            self._radix.record(False)
+        self.stats.prompt_admitted(n_tok)
+        return False
+
+    def _chunk_tick(self, reset_mask) -> bool:
+        """Dispatch ONE prefill chunk — the chunked-prefill sibling of
+        :meth:`_overlap_prefill`, called at the same seam (between the
+        window dispatch and its blocking readback) so the chunk's compute
+        hides behind the in-flight window; also called when no window
+        dispatched (nothing decoding) so prefill still progresses.  One
+        chunk per engine iteration TOTAL bounds every co-resident
+        request's added decode latency at one chunk.  Picks the oldest
+        PREFILLING slot (FIFO by request id).  Returns True when a chunk
+        was dispatched (watchdog progress)."""
+        pick = None
+        for slot, rec in enumerate(self._slot_prefill):
+            if rec is None:
+                continue
+            if pick is None or self._slot_req[slot].id < self._slot_req[pick].id:
+                pick = slot
+        if pick is None:
+            return False
+        slot, rec = pick, self._slot_prefill[pick]
+        req = self._slot_req[slot]
+        c = self._prefill_chunk
+        done = rec["done"]
+        suffix = req.tokens[done:done + c]
+        t_c0 = self.clock()
+        try:
+            padded = np.full((1, c), self.pad_id, np.int32)
+            padded[0, : suffix.size] = suffix
+            # ONE program per chunk SIZE, not per prompt length: every
+            # chunk of every prompt is this same (1, C) extend — the
+            # census stays pinned and long prompts need no bucket
+            with self._compile.site(f"extend[b{c}]"):
+                self.cache, ext_logits = self._extend(
+                    self.params, self.cache, jnp.asarray(slot, jnp.int32),
+                    rec["bt_dev"], jnp.asarray(padded),
+                    jnp.asarray(done, jnp.int32),
+                    jnp.asarray(int(suffix.size), jnp.int32))
+            rec["done"] = done + int(suffix.size)
+            rec["last"] = ext_logits
+            # the extend installed the slot's real block table — a reset
+            # pending from the previous tenant must not zero it back
+            reset_mask[slot] = False
+            t_c1 = self.clock()
+            self.stats.chunk(t_c1 - t_c0)
+            if self._tracer is not None and req.trace is not None:
+                # per-chunk child span under the request's admit phase
+                self._tracer.complete(
+                    "prefill_chunk", t_c0, t_c1, cat="serving",
+                    parent=req.trace.get("phase") or req.trace["id"],
+                    tid=req.trace["tid"], start=done,
+                    tokens=int(suffix.size))
+            return True
+        except Exception as e:
+            # the chunk's failure is THIS request's failure (isolated) —
+            # the slot frees and its pages queue for release
+            self._slot_req[slot] = None
+            self._slot_prefill[slot] = None
+            self._release_slot_alloc(slot)
+            self._active_dev = None
+            self._fail(req, e, self.clock())
+            reset_mask[slot] = True
+            return False
+
+    def _chunk_finish(self, slot: int, rec: dict, req: Request,
+                      reset_mask) -> None:
+        """The last chunk landed: pick the first token from its final-
+        position logits (the shared ``first_pick`` program — same as
+        every other landing path), donate the freshly-prefilled whole
+        prompt pages into the radix trie, and run the standard admission
+        tail (TTFT/SLO/telemetry, streaming callback, planes, decode
+        phase).  Failure here is the request's own, exactly like the
+        whole-prompt admission tail."""
+        now = self.clock()
+        try:
+            first, first_logp = self._first_pick(req, rec["last"])
+            if self._radix is not None:
+                n_tok = int(req.tokens.size)
+                bt_row, m_blocks = rec["bt"], rec["m_blocks"]
+                donate = {j: int(bt_row[j])
+                          for j in range(m_blocks, n_tok // self._page_size)}
+                if donate:
+                    priv, nodes = self._slot_alloc[slot]
+                    held, _kept = self._radix.insert(
+                        req.tokens, m_blocks, donate, rec["path"])
+                    for node in held:
+                        priv.remove(node.page)
+                        nodes.append(node)
+            req.generated.append(first)
+            req.logprobs.append(first_logp)
+            req.first_token_t = self.clock()  # TTFT: first token ON THE HOST
+            self._last_progress_ever = req.first_token_t
+            if req.ttft_slo_s is not None:
+                req.slo_ttft_ok = (
+                    req.first_token_t - req.submit_t <= req.ttft_slo_s)
+            if self._telemetry is not None:
+                self._telemetry.observe(
+                    "ttft_s", req.first_token_t - req.submit_t)
+                self._telemetry.inc("tokens_generated")
+            req.status = "running"
+            self._tr_instant(req, "first_token", slot=slot,
+                             cache_hit=False)
+            self._notify(req, first)
+        except Exception as e:
+            self._slot_req[slot] = None
+            self._slot_prefill[slot] = None
+            self._release_slot_alloc(slot)
+            self._active_dev = None
+            self._fail(req, e, self.clock())
+            reset_mask[slot] = True
+            return
+        self._slot_prefill[slot] = None
+        self._slot_tok[slot] = first
+        temp, topp, topk, key = self._req_sampling(req)
+        self._slot_temp[slot] = temp
+        self._slot_topp[slot] = topp
+        self._slot_topk[slot] = topk
+        self._slot_key[slot] = key
+        self._tok_dev = None  # host mirrors changed; re-upload
+        self._active_dev = None
+        self._planes_dev = None
+        self._pos_dev = None
+        self._tr_phase(req, "decode", slot=slot)
+        if self._done_reason(req) is not None:
+            self._retire(slot, self._done_reason(req), self.clock())
+            reset_mask[slot] = True
+
+    def _chunk_land(self, reset_mask) -> None:
+        """Land any slot whose LAST chunk has been dispatched.  Runs
+        AFTER the window readback (not at the dispatch seam) so the
+        landing's host-mirror writes — ``_slot_tok[slot]``, the sampling
+        planes, the mirror invalidations — are not clobbered by the
+        readback's wholesale ``blk[:, -1]`` copy."""
+        for slot, rec in enumerate(self._slot_prefill):
+            if rec is None or rec["last"] is None:
+                continue
+            req = self._slot_req[slot]
+            if rec["done"] >= int(req.tokens.size):
+                self._chunk_finish(slot, rec, req, reset_mask)
 
     def _admit_free_slots(self, reset_mask) -> bool:
         """Fill free slots: overlap-prefilled pendings first (they were
@@ -1299,7 +1618,10 @@ class InferenceEngine:
                     return admitted
                 if self._slot_req[slot] is not None:
                     admitted = True
-                    reset_mask[slot] = False  # insert fully overwrote the row
+                    if self._slot_prefill[slot] is None:
+                        reset_mask[slot] = False  # insert overwrote the row
+                    # else PREFILLING: keep any pending reset — the block
+                    # table must stay TRASH until a chunk installs it
                 elif needs_reset:
                     # the row was claimed but belongs to no live request
                     # (post-insert failure, or retired at admission); zero
@@ -1361,8 +1683,14 @@ class InferenceEngine:
         #    re-raises immediately.
         produced = 0
         decoded = False
+        chunked = False
         occupied_at_dispatch = self.occupied
-        if occupied_at_dispatch > 0:
+        # PREFILLING slots are occupied but not decoding: a window with
+        # zero decoding rows would be pure waste (and a spurious
+        # serving-step chaos event), so the dispatch gates on decoding
+        decoding_at_dispatch = (self._decoding if self._prefill_chunk
+                                else occupied_at_dispatch)
+        if decoding_at_dispatch > 0:
             spec = self._verify is not None
             # speculative mode replaces the decode-ahead scan with ONE
             # (slots, draft_len+1)-position verify forward per window —
@@ -1398,7 +1726,7 @@ class InferenceEngine:
                     chunk[:, 0] = self._slot_tok
                     dls = np.zeros((self.slots,), np.int32)
                     for slot, req in enumerate(self._slot_req):
-                        if req is None:
+                        if req is None or self._slot_prefill[slot] is not None:
                             continue
                         d = self._drafter.draft(np.concatenate(
                             [req.tokens,
@@ -1427,13 +1755,19 @@ class InferenceEngine:
                             [0 if r is None else len(r.generated)
                              for r in self._slot_req], np.int32))
                 if self._active_dev is None:
-                    self._active_dev = self._dev(
-                        np.array([r is not None for r in self._slot_req]))
+                    # PREFILLING slots stay INACTIVE: their pages hold a
+                    # partial prompt — garbage decode writes above the
+                    # chunk cursor are overwritten by the next chunk
+                    self._active_dev = self._dev(np.array(
+                        [r is not None and p is None
+                         for r, p in zip(self._slot_req,
+                                         self._slot_prefill)]))
                 if self._planes_dev is None:
                     self._planes_dev = (self._dev(self._slot_temp),
                                         self._dev(self._slot_topp),
+                                        self._dev(self._slot_topk),
                                         self._dev(self._slot_key))
-                temps_dev, topps_dev, keys_dev = self._planes_dev
+                temps_dev, topps_dev, topks_dev, keys_dev = self._planes_dev
                 t_disp = self.clock()
                 if spec:
                     with self._compile.site(f"verify_window[k{k}]"):
@@ -1441,14 +1775,14 @@ class InferenceEngine:
                             self._verify(
                                 self.params, self.cache, chunk_dev, dls_dev,
                                 self._active_dev, temps_dev, topps_dev,
-                                keys_dev, pos_dev)
+                                topks_dev, keys_dev, pos_dev)
                 else:
                     with self._compile.site(f"decode_window[k{k}]"):
                         self.cache, blk_dev, logp_dev, last_dev, pos_out = \
                             self._window(
                                 self.params, self.cache, self._tok_dev,
                                 self._active_dev, temps_dev, topps_dev,
-                                keys_dev, self._pos_dev)
+                                topks_dev, keys_dev, self._pos_dev)
                 dispatch_s = self.clock() - t_disp
             except Exception as e:
                 now = self.clock()
@@ -1484,8 +1818,13 @@ class InferenceEngine:
             else:
                 decoded = True
                 # the window is in flight (async dispatch): spend the wait
-                # prefilling the next queued request instead of blocking
-                self._overlap_prefill()
+                # prefilling instead of blocking — one chunk of the oldest
+                # PREFILLING slot in chunked mode, else the next queued
+                # request's bucketed prefill
+                if self._prefill_chunk:
+                    chunked = self._chunk_tick(reset_mask)
+                else:
+                    self._overlap_prefill()
                 # ONE blocking host sync per window: the (slots, k) block
                 # serves the host inspection below, and `last` (the final
                 # carry token) feeds the next window without a host slice
@@ -1507,8 +1846,8 @@ class InferenceEngine:
                 t_acc0 = t_rb + readback_s
                 waste = 0
                 for slot, req in enumerate(self._slot_req):
-                    if req is None:
-                        continue
+                    if req is None or self._slot_prefill[slot] is not None:
+                        continue  # PREFILLING rows were inactive: no tokens
                     n_emit = k
                     if spec:
                         # accepted drafts + the model's one free correction
@@ -1566,7 +1905,7 @@ class InferenceEngine:
                     # / rejected lanes) is the window's waste
                     waste += k - appended
                 self.stats.window(dispatch_s, readback_s,
-                                  steps=occupied_at_dispatch * k, waste=waste)
+                                  steps=decoding_at_dispatch * k, waste=waste)
                 if self._tracer is not None:
                     wid = self._tracer.complete(
                         "window", t_w0, self.clock(), cat="serving", k=k,
@@ -1579,6 +1918,15 @@ class InferenceEngine:
                                           t_rb + readback_s, cat="serving",
                                           tid=self._trace_tid, parent=wid)
 
+        if self._prefill_chunk:
+            if not decoded:
+                # nothing decoding (every occupied slot PREFILLING, or the
+                # window faulted): chunks still pump — one per iteration
+                chunked = self._chunk_tick(reset_mask)
+            # land AFTER the readback so the wholesale _slot_tok copy
+            # above cannot clobber a landed request's first token
+            self._chunk_land(reset_mask)
+
         # 4) zero retired rows so idle cursors restart from 0 (bounded) and
         #    the next admission starts from a clean row
         if reset_mask.any():
@@ -1590,7 +1938,7 @@ class InferenceEngine:
         # tenant of the reallocated pages writes them
         self._flush_freed_pages()
 
-        if produced > 0 or admitted or self.occupied == 0:
+        if produced > 0 or admitted or chunked or self.occupied == 0:
             self._last_progress_t = self.clock()
             self._last_progress_ever = self._last_progress_t
         if self._pool is not None:
@@ -1621,6 +1969,7 @@ class InferenceEngine:
             if req is None:
                 continue
             self._slot_req[slot] = None
+            self._slot_prefill[slot] = None
             self._release_slot_alloc(slot)
             req.engine_fault = True  # collateral, not the request's own fault
             self._fail(req, exc, now)
@@ -1827,57 +2176,76 @@ class InferenceEngine:
                 "launch path, before the first submit")
         t0 = self.clock()
         before = self._compile.snapshot()
-        last_logits = None
-        for b in self.buckets:
-            with self._compile.site(f"prefill[b{b}]"):
-                _, last_logits = self._prefill_row(
-                    self.params, jnp.zeros((1, b), jnp.int32),
-                    jnp.ones((1,), jnp.int32))
+        slot0 = jnp.asarray(0, jnp.int32)
+        if self._prefill_chunk:
+            # chunked mode never dispatches bucketed prefills or the
+            # dense slot insert: the resident prefill family is the ONE
+            # extend[b{C}] program every chunk of every prompt runs
+            # through, warmed here over the trash-page block table
+            # (garbage K/V the admission protocol already tolerates)
+            c = self._prefill_chunk
+            bt_row = self._dev(np.zeros((self.max_len // self._page_size,),
+                                        np.int32))
+            with self._compile.site(f"extend[b{c}]"):
+                self.cache, last_logits = self._extend(
+                    self.params, self.cache, slot0, bt_row,
+                    jnp.zeros((1, c), jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(1, jnp.int32))
+        else:
+            last_logits = None
+            for b in self.buckets:
+                with self._compile.site(f"prefill[b{b}]"):
+                    _, last_logits = self._prefill_row(
+                        self.params, jnp.zeros((1, b), jnp.int32),
+                        jnp.ones((1,), jnp.int32))
         # the shared first-token pick over the (1, V) prefill logits —
         # same program whatever landing path (miss/hit/extend) runs it
         with self._compile.site("first_pick"):
             first_pick(last_logits,
                        self._dev(np.zeros((1,), np.float32)),
                        self._dev(np.zeros((1,), np.float32)),
-                       self._dev(np.zeros((1, 2), np.uint32)),
                        self._dev(np.zeros((1,), np.int32)),
-                       top_k=self._top_k)
-        # a zeroed B=1 prefill row in the dense decode layout — the same
-        # eval_shape probe init_cache uses, so dtypes (incl. int8+scales)
-        # match what a real prefill hands to insert
-        row_shapes = jax.eval_shape(
-            lambda p: self.model.apply(
-                {"params": p}, jnp.zeros((1, 1), jnp.int32),
-                decode=True, max_len=self.max_len, ragged=True,
-                mutable=["cache"])[1]["cache"],
-            self.params)
-        row_cache = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), row_shapes)
-        if self._mesh is not None:
-            # match the layout a REAL prefill's pinned output arrives in,
-            # so prewarm compiles the same insert program serving reuses
-            row_cache = jax.device_put(row_cache, mesh_shardings(
-                self._mesh, make_param_specs(row_shapes, self._kv_rule)))
-        slot0 = jnp.asarray(0, jnp.int32)
-        if self._pool is not None:
-            bt_row = self._dev(np.zeros((self.max_len // self._page_size,),
-                                        np.int32))
-            with self._compile.site("slot_insert"):
-                self.cache = self._insert(self.cache, row_cache, bt_row,
-                                          slot0)
-            for b in self.buckets:
-                with self._compile.site(f"extend[b{b}]"):
-                    self.cache, _ = self._extend(
-                        self.params, self.cache, slot0, bt_row,
-                        jnp.zeros((1, b), jnp.int32),
-                        jnp.asarray(0, jnp.int32),
-                        jnp.asarray(1, jnp.int32))
-        else:
-            with self._compile.site("slot_insert"):
-                self.cache = self._insert(self.cache, row_cache, slot0)
+                       self._dev(np.zeros((1, 2), np.uint32)),
+                       self._dev(np.zeros((1,), np.int32)))
+        if not self._prefill_chunk:
+            # a zeroed B=1 prefill row in the dense decode layout — the
+            # same eval_shape probe init_cache uses, so dtypes (incl.
+            # int8+scales) match what a real prefill hands to insert
+            row_shapes = jax.eval_shape(
+                lambda p: self.model.apply(
+                    {"params": p}, jnp.zeros((1, 1), jnp.int32),
+                    decode=True, max_len=self.max_len, ragged=True,
+                    mutable=["cache"])[1]["cache"],
+                self.params)
+            row_cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), row_shapes)
+            if self._mesh is not None:
+                # match the layout a REAL prefill's pinned output arrives
+                # in, so prewarm compiles the same insert program serving
+                # reuses
+                row_cache = jax.device_put(row_cache, mesh_shardings(
+                    self._mesh, make_param_specs(row_shapes, self._kv_rule)))
+            if self._pool is not None:
+                bt_row = self._dev(
+                    np.zeros((self.max_len // self._page_size,), np.int32))
+                with self._compile.site("slot_insert"):
+                    self.cache = self._insert(self.cache, row_cache, bt_row,
+                                              slot0)
+                for b in self.buckets:
+                    with self._compile.site(f"extend[b{b}]"):
+                        self.cache, _ = self._extend(
+                            self.params, self.cache, slot0, bt_row,
+                            jnp.zeros((1, b), jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(1, jnp.int32))
+            else:
+                with self._compile.site("slot_insert"):
+                    self.cache = self._insert(self.cache, row_cache, slot0)
         inactive = self._dev(np.zeros((self.slots,), bool))
         temps0 = self._dev(np.zeros((self.slots,), np.float32))
         topps0 = self._dev(np.zeros((self.slots,), np.float32))
+        topks0 = self._dev(np.zeros((self.slots,), np.int32))
         keys0 = self._dev(np.zeros((self.slots, 2), np.uint32))
         pos0 = self._dev(np.zeros((self.slots,), np.int32))
         if self._verify is not None:
@@ -1888,14 +2256,14 @@ class InferenceEngine:
                     self._dev(np.full((self.slots, k), self.pad_id,
                                       np.int32)),
                     self._dev(np.zeros((self.slots,), np.int32)), inactive,
-                    temps0, topps0, keys0, pos0)
+                    temps0, topps0, topks0, keys0, pos0)
         else:
             k = self.decode_ahead
             with self._compile.site(f"decode_window[k{k}]"):
                 self.cache, _, _, _, _ = self._window(
                     self.params, self.cache,
                     self._dev(np.zeros((self.slots,), np.int32)), inactive,
-                    temps0, topps0, keys0, pos0)
+                    temps0, topps0, topks0, keys0, pos0)
         with self._compile.site("slot_reset"):
             self.cache = self._reset(self.cache, inactive)
         delta = CompileTracker.delta(self._compile.snapshot(), before)

@@ -1,9 +1,10 @@
 """Per-request sampling for the serving tier (ISSUE 13).
 
 A request carries a :class:`SamplingParams` — ``(temperature, top_p,
-seed)`` — validated at submit time, and the engine turns the per-slot
-values into device-side DATA planes: (slots,) float32 temperature and
-top-p vectors plus a (slots, 2) uint32 base-key plane, all fed to the
+top_k, seed)`` — validated at submit time, and the engine turns the
+per-slot values into device-side DATA planes: (slots,) float32
+temperature and top-p vectors, a (slots,) int32 top-k vector (ISSUE 14),
+plus a (slots, 2) uint32 base-key plane, all fed to the
 SAME compiled decode/verify programs regardless of the mix (the
 one-program-many-behaviors discipline the census gates pin; see
 core/generate.py ``_pick_rows`` / ``_sample_window_core`` /
@@ -33,7 +34,6 @@ deterministic prefill logits, never a sampled token).
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import numpy as np
@@ -45,9 +45,11 @@ from distributed_tensorflow_ibm_mnist_tpu.core.generate import _pick_rows
 class SamplingParams:
     """Validated per-request sampling config.
 
-    ``temperature == 0`` is greedy (argmax; ``top_p`` must be 0 and the
-    seed is inert), ``temperature > 0`` samples the tempered distribution,
-    optionally nucleus-filtered by ``0 < top_p < 1``.  ``seed`` fully
+    ``temperature == 0`` is greedy (argmax; ``top_p``/``top_k`` must be 0
+    and the seed is inert), ``temperature > 0`` samples the tempered
+    distribution, optionally truncated to the ``top_k`` highest-logit
+    tokens and/or nucleus-filtered by ``0 < top_p < 1`` (top-k applies
+    first, like the offline generator).  ``seed`` fully
     determines the request's token stream at fixed params/prompt —
     submit the same seed twice and the streams are token-identical;
     best-of-n is "same prompt, n seeds" (examples/11_sampling.py).
@@ -55,10 +57,11 @@ class SamplingParams:
 
     temperature: float = 0.0
     top_p: float = 0.0
+    top_k: int = 0
     seed: int = 0
 
     def __post_init__(self):
-        t, p, s = self.temperature, self.top_p, self.seed
+        t, p, k, s = self.temperature, self.top_p, self.top_k, self.seed
         if not (isinstance(t, (int, float)) and np.isfinite(t) and t >= 0):
             raise ValueError(
                 f"temperature must be a finite float >= 0, got {t!r}")
@@ -67,6 +70,12 @@ class SamplingParams:
         if p and t == 0:
             raise ValueError(
                 "top_p filters a SAMPLING distribution; set temperature > 0")
+        if (not isinstance(k, (int, np.integer)) or isinstance(k, bool)
+                or int(k) < 0):
+            raise ValueError(f"top_k must be an int >= 0, got {k!r}")
+        if k and t == 0:
+            raise ValueError(
+                "top_k filters a SAMPLING distribution; set temperature > 0")
         if not isinstance(s, (int, np.integer)) or isinstance(s, bool):
             raise ValueError(f"seed must be an int, got {s!r}")
         if not 0 <= int(s) < (1 << 64):
@@ -94,13 +103,14 @@ def base_key(seed: int) -> np.ndarray:
                     np.uint32)
 
 
-@functools.partial(jax.jit, static_argnames=("top_k",))
-def first_pick(logits, temps, topps, keys, pos, top_k=0):
+@jax.jit
+def first_pick(logits, temps, topps, topks, keys, pos):
     """The shared first-token pick program: fold each row's base key at
     its generated index (0 for a fresh request) and pick with the same
     data-driven math the decode window uses.  Module-level jit: every
-    engine in the process shares one compilation per (shape, top_k), and
-    prefix-cache hit/miss paths are bit-identical by construction.
+    engine in the process shares one compilation per shape (top-k rides
+    the ``topks`` DATA plane — ISSUE 14), and prefix-cache hit/miss
+    paths are bit-identical by construction.
     Returns ``((B,) int32 token, (B,) float32 logprob)``."""
     step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
-    return _pick_rows(logits, temps, topps, step_keys, top_k)
+    return _pick_rows(logits, temps, topps, topks, step_keys)

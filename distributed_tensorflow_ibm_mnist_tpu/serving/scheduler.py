@@ -47,7 +47,10 @@ class Request:
 
     The scheduler fills the identity/admission fields; the engine fills the
     timing/output fields as the request moves through a slot.  ``status``
-    walks queued -> running -> (done | cancelled | failed): ``failed`` is
+    walks queued -> running -> (done | cancelled | failed) — a chunked-
+    prefill engine (ISSUE 14) inserts a transient ``prefilling`` between
+    queued and running while the prompt advances chunk by chunk.
+    ``failed`` is
     the TERMINAL state of a request whose own processing raised (poisoned
     prompt at prefill, raising user ``callback``) — the failure is
     isolated to this request (``error`` records it) and the engine keeps
@@ -121,7 +124,7 @@ class FIFOScheduler:
 
     def __init__(self, max_len: int, buckets: tuple[int, ...] = (16, 32, 64, 128),
                  max_queue: int = 64, clock: Callable[[], float] = time.monotonic,
-                 tracer=None):
+                 tracer=None, chunked_prefill: bool = False):
         if not buckets:
             raise ValueError("need at least one prefill bucket")
         if max_queue < 1:
@@ -137,6 +140,13 @@ class FIFOScheduler:
             )
         self.max_queue = max_queue
         self.clock = clock
+        # chunked-prefill admission regime (ISSUE 14): the engine prefills
+        # prompts in fixed chunks through ONE extend program, so a prompt
+        # needs NO matching bucket — submit accepts any length that fits
+        # the cache (len + max_new <= max_len) and `bucket` is capped at
+        # the largest bucket (it still keys prefix_key and stats; it is
+        # never a compiled prefill shape in this regime)
+        self.chunked_prefill = bool(chunked_prefill)
         # utils/tracing.Tracer | None.  The scheduler owns the submit end of
         # a request's span tree (the request root span + its queue-wait
         # phase); the engine adopts the same tracer (engine construction
@@ -158,7 +168,9 @@ class FIFOScheduler:
                 return b
         raise ValueError(
             f"prompt length {n} exceeds the largest prefill bucket "
-            f"({self.buckets[-1]}) — raise buckets= or shorten the prompt"
+            f"({self.buckets[-1]}) — raise buckets=, shorten the prompt, "
+            f"or serve with InferenceEngine(prefill_chunk=...) (chunked "
+            f"prefill admits any prompt that fits the cache)"
         )
 
     def submit(self, prompt, max_new: int, deadline_s: float | None = None,
@@ -195,7 +207,13 @@ class FIFOScheduler:
                 f"prompt ({tokens.size}) + max_new ({max_new}) exceeds the "
                 f"engine cache length ({self.max_len})"
             )
-        bucket = self.bucket_for(tokens.size)
+        if self.chunked_prefill and tokens.size > self.buckets[-1]:
+            # chunked engines never dispatch bucketed prefills: long
+            # prompts ride capped at the largest bucket (a label, not a
+            # compiled shape) — the max_len check above already gated
+            bucket = self.buckets[-1]
+        else:
+            bucket = self.bucket_for(tokens.size)
         if len(self._queue) >= self.max_queue:
             raise QueueFull(
                 f"request queue full ({self.max_queue}) — retry later or "

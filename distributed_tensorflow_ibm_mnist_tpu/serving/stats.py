@@ -182,6 +182,13 @@ class ServingStats:
         self._radix_hits = 0
         self._radix_misses = 0
         self._radix_hit_tokens = 0
+        # --- chunked-prefill accounting (ISSUE 14) --- all zero/None on
+        # whole-prompt engines, so the schema stays stable across regimes
+        self._prefill_chunks = 0     # extend[b{C}] dispatches
+        self._chunk_stall_s = 0.0    # total wall seconds inside chunk
+        #   dispatches (the decode-latency budget chunking bounds)
+        self._longest_prompt = 0     # max admitted prompt tokens; 0 = no
+        #   admission recorded (summary reports None)
         # --- compile accounting (ISSUE 6) --- the engine's own XLA
         # program family: a CompileTracker snapshot DELTA from engine
         # construction to stats emission (utils/tracing.py)
@@ -253,6 +260,20 @@ class ServingStats:
             self._radix_hit_tokens += int(tokens)
         else:
             self._radix_misses += 1
+
+    def chunk(self, stall_s: float) -> None:
+        """One chunked-prefill dispatch (ISSUE 14): ``stall_s`` = wall
+        seconds the dispatch occupied the host loop — the bounded
+        per-iteration decode-latency cost the chunked_prefill bench leg
+        gates on."""
+        self._prefill_chunks += 1
+        self._chunk_stall_s += float(stall_s)
+
+    def prompt_admitted(self, n_tokens: int) -> None:
+        """One admission's prompt length (chunked engines call this at
+        allocation) — ``longest_prompt_admitted`` documents the regime's
+        headline capability: prompts past every bucket."""
+        self._longest_prompt = max(self._longest_prompt, int(n_tokens))
 
     def memory(self, tp: int, kv_bytes_per_chip: int,
                weight_bytes_per_chip: int, quant: str = "none") -> None:
@@ -437,6 +458,20 @@ class ServingStats:
                       / (self._radix_hits + self._radix_misses), 4)
                 if (self._radix_hits + self._radix_misses) > 0 else None
             ),
+            # chunked prefill (ISSUE 14; all-zero/None on whole-prompt
+            # engines).  chunk_stall_frac = share of busy time spent
+            # inside chunk dispatches — the interleaving tax the bench
+            # leg bounds.
+            "n_prefill_chunks": self._prefill_chunks,
+            "chunk_stall_s": round(self._chunk_stall_s, 6),
+            "chunk_stall_frac": (
+                round(self._chunk_stall_s / self._busy_time, 4)
+                if self._busy_time > 0 and self._prefill_chunks > 0
+                else None
+            ),
+            "longest_prompt_admitted": (
+                self._longest_prompt if self._longest_prompt > 0 else None
+            ),
             # compile accounting (None until set_compile — an engine that
             # never emitted stats has no delta to report)
             "n_compiled_programs": (
@@ -473,6 +508,7 @@ class ServingStats:
             "accept_rate": (round(self._spec_accepted / self._spec_drafted, 4)
                             if self._spec_drafted > 0 else None),
             "n_sampled_requests": self._n_sampled,
+            "n_prefill_chunks": self._prefill_chunks,
             "kv_pages_live": self._kv_pages_live,
             "kv_pages_total": self._kv_pages_total,
             "slo_tracked": self._slo_tracked,
@@ -530,6 +566,11 @@ class ServingStats:
         r_hits = sum(rec._radix_hits for rec in records)
         r_miss = sum(rec._radix_misses for rec in records)
         compiled = [rec._compile for rec in records if rec._compile is not None]
+        n_chunks = sum(rec._prefill_chunks for rec in records)
+        chunk_stall = sum(rec._chunk_stall_s for rec in records)
+        busy_total = sum(rec._busy_time for rec in records)
+        longest = [rec._longest_prompt for rec in records
+                   if rec._longest_prompt > 0]
         n_sampled = sum(rec._n_sampled for rec in records)
         temp_sum = sum(rec._temp_sum for rec in records)
         nll = HistogramSketch.merge([rec._nll for rec in records])
@@ -614,6 +655,16 @@ class ServingStats:
             "radix_hit_tokens": sum(rec._radix_hit_tokens for rec in records),
             "radix_hit_rate": (round(r_hits / (r_hits + r_miss), 4)
                                if (r_hits + r_miss) > 0 else None),
+            # chunked prefill (ISSUE 14): counters sum, the stall fraction
+            # re-derives over the merged busy time, and the longest prompt
+            # is a cluster-wide max (None when no engine recorded one)
+            "n_prefill_chunks": n_chunks,
+            "chunk_stall_s": round(chunk_stall, 6),
+            "chunk_stall_frac": (
+                round(chunk_stall / busy_total, 4)
+                if busy_total > 0 and n_chunks > 0 else None),
+            "longest_prompt_admitted": (
+                max(longest) if longest else None),
             "tp": tps.pop() if len(tps) == 1 else None,
             # common scheme or None when replicas disagree (a mid-rollout
             # mixed fleet is visible, never silently averaged)

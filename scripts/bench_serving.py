@@ -103,6 +103,20 @@ skip it independently with ``DTM_BENCH_SKIP_SAMPLING``):
   ``sample_cold``/``sample_repeat`` at ZERO new programs — sampling
   configs are data planes in one program family, never new programs.
 
+One more block (ISSUE 14, run via ``--chunked-only`` so bench.py can
+skip it independently with ``DTM_BENCH_SKIP_CHUNKED``):
+
+* **chunked_prefill** — ``InferenceEngine(prefill_chunk=C)`` under a
+  long-prompt stream, four gates: decode TPOT p99 stays flat (≤ 1.15x a
+  no-long-prompt control on the SAME engine) while prompts past every
+  bucket admit chunk-by-chunk; short-request TTFT p99 is held; the
+  chunked stream is token-identical to the same stream through a
+  whole-prompt engine with a big-enough bucket (parity — chunking is a
+  latency schedule, never different math); and the chunk program family
+  is census-pinned (``chunked_cold`` exact, ``chunked_repeat`` ZERO —
+  one ``extend[b{C}]`` program serves every prompt length).  Gate
+  breaches exit 3.
+
 ``DTM_BENCH_QUICK=1`` shrinks models/streams to a CI smoke of the same
 code paths (exercised by a ``slow``-marked test so harness rot is caught
 without paying the full sweep); the record carries ``"quick": true``.
@@ -478,6 +492,218 @@ def run_sampling(slots: int, requests: int) -> dict:
     }
 
 
+def run_chunked(slots: int, requests: int) -> dict:
+    """ISSUE 14 acceptance, bench-shaped (``--chunked-only`` block).
+
+    The regime: a stream where every 4th prompt is LONGER than every
+    prefill bucket (48..64 tokens vs bucket 32) served by a chunked
+    engine (``prefill_chunk=8``), beside a no-long-prompt control on the
+    SAME engine.  Chunking's contract is that admitting a long prompt
+    costs the decoding slots one bounded chunk per engine iteration —
+    never a whole-prompt prefill stall — so the four HARD gates (any
+    breach exits 3) are:
+
+    * **tpot_flat** — decode TPOT p99 of the mixed stream's SHORT
+      requests ≤ 1.15x the control's TPOT p99.  The chunk rides the
+      prefill-overlap seam (dispatched between the window dispatch and
+      its blocking readback), so its cost must mostly hide under the
+      in-flight window (chunk FLOPs here are ~1/8 of a window's).
+    * **ttft_held** — the mixed stream's short-request TTFT p99 stays
+      within ``TTFT_HELD_X`` of control: long admissions must not
+      starve short ones out of their first token.
+    * **parity** — the mixed stream through a whole-prompt engine
+      (bucket 64 so the long prompts fit densely) is token-identical to
+      the chunked serve.  Chunking is a latency SCHEDULE over the same
+      suffix-extend math, never a different computation.
+    * **census** — a fresh chunked engine's cold program set is pinned
+      (``chunked_cold``) and a second long-prompt stream compiles ZERO
+      new programs (``chunked_repeat``): ONE ``extend[b{C}]`` program
+      serves every prompt length, so prompt length can never trigger a
+      compile storm — the point of chunking over a bucket ladder.
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+    CHUNK, AHEAD, PAGE = 8, 48, 8
+    LONG_LO, LONG_HI = 48, 64
+    max_len = LONG_HI + SHORT_NEW + 8
+
+    def make_streams(n, seed):
+        """(control, mixed): identical SHORT prompts; mixed swaps every
+        4th for a past-every-bucket long one.  max_new is uniformly
+        SHORT_NEW — the leg measures prefill admission cost, so decode
+        budgets are held equal across legs."""
+        rng = np.random.default_rng(seed)
+        control, mixed = [], []
+        for i in range(n):
+            short = rng.integers(
+                1, VOCAB - 1, size=(int(rng.integers(4, 29)),)
+            ).astype(np.int32)
+            control.append((short, SHORT_NEW))
+            if i % 4 == 0:
+                long_p = rng.integers(
+                    1, VOCAB - 1,
+                    size=(int(rng.integers(LONG_LO, LONG_HI + 1)),)
+                ).astype(np.int32)
+                mixed.append((long_p, SHORT_NEW))
+            else:
+                mixed.append((short, SHORT_NEW))
+        return control, mixed
+
+    # --- census sub-leg FIRST (small model, fresh process): the chunked
+    # engine's cold set — including the module-level pick/helper jits
+    # this standalone process hasn't warmed yet — then a SECOND
+    # long-prompt stream that must compile NOTHING (one extend[b8]
+    # program, whatever the prompt length)
+    tracker = CompileTracker.install()
+    cmodel = get_model("causal_lm", num_classes=VOCAB, dim=DA_DIM,
+                       depth=DA_DEPTH, heads=DA_HEADS, dtype=jnp.float32)
+    cparams = cmodel.init(jax.random.PRNGKey(14),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def chunked_engine(model, params, n_queue, radix=False):
+        return InferenceEngine(
+            model, params, slots=slots, max_len=max_len,
+            kv_page_size=PAGE, prefill_chunk=CHUNK, decode_ahead=AHEAD,
+            radix_cache=radix,
+            scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET,),
+                                    max_queue=n_queue))
+
+    def census_serve(engine, streams):
+        before = tracker.snapshot()
+        reqs = [engine.submit(p, max_new=mn) for p, mn in streams]
+        engine.run()
+        d = CompileTracker.delta(tracker.snapshot(), before)
+        assert all(len(r.generated) == mn for r, (_, mn) in
+                   zip(reqs, streams))
+        return {"n_new_programs": d["n_compiled_programs"],
+                "by_site": {k: v["n"] for k, v in d["by_site"].items()}}
+
+    ceng = chunked_engine(cmodel, cparams, 16)
+    _, cmix1 = make_streams(8, seed=20)
+    _, cmix2 = make_streams(8, seed=21)
+    census = {"chunked_cold": census_serve(ceng, cmix1),
+              "chunked_repeat": census_serve(ceng, cmix2)}
+    ceng.close()
+    census_over = {
+        name: leg["n_new_programs"] - CENSUS_BUDGET[name]
+        for name, leg in census.items()
+        if leg["n_new_programs"] > CENSUS_BUDGET[name]}
+
+    # --- timed legs: the compute-dominant model (same regime argument
+    # as the headline serving leg — a dispatch-bound toy model would
+    # measure the host loop, not the chunk schedule)
+    model = get_model("causal_lm", num_classes=VOCAB, dim=DIM, depth=DEPTH,
+                      heads=HEADS, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(15),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    control, mixed = make_streams(requests, seed=22)
+    short_idx = [i for i in range(requests) if i % 4 != 0]
+
+    def serve(eng, stream):
+        from distributed_tensorflow_ibm_mnist_tpu.serving.stats import (
+            ServingStats,
+        )
+
+        eng.completed.clear()
+        eng.stats = ServingStats(slots, decode_ahead=eng.decode_ahead)
+        reqs = [eng.submit(p, max_new=mn) for p, mn in stream]
+        eng.run()
+        ttft = [r.first_token_t - r.submit_t for r in reqs]
+        tpot = {i: (r.finish_t - r.first_token_t) / (len(r.generated) - 1)
+                for i, r in enumerate(reqs) if len(r.generated) >= 2}
+        outs = [np.asarray(r.generated) for r in reqs]
+        return ttft, tpot, outs, eng.stats.summary()
+
+    # radix off in the timed/parity legs: prefix sharing would skip
+    # chunks for whichever leg ran second — the comparison is the chunk
+    # SCHEDULE, so both engines prefill every admitted token
+    eng = chunked_engine(model, params, 2 * requests + 8)
+    warm, warm_mixed = make_streams(max(slots * 2, 8), seed=23)
+    for p, mn in warm + warm_mixed:  # warm both prompt shapes' programs
+        eng.submit(p, max_new=mn)
+    eng.run()
+
+    c_ttft, c_tpot, _, _ = serve(eng, control)
+    m_ttft, m_tpot, m_out, m_summ = serve(eng, mixed)
+    eng.close()
+
+    # parity: whole-prompt engine, bucket 64 so long prompts fit densely
+    weng = InferenceEngine(
+        model, params, slots=slots, max_len=max_len,
+        kv_page_size=PAGE, decode_ahead=AHEAD, radix_cache=False,
+        scheduler=FIFOScheduler(max_len=max_len, buckets=(BUCKET, LONG_HI),
+                                max_queue=2 * requests + 8))
+    for p, mn in warm + warm_mixed:
+        weng.submit(p, max_new=mn)
+    weng.run()
+    _, _, w_out, _ = serve(weng, mixed)
+    weng.close()
+    mismatches = sum(not np.array_equal(a, b)
+                     for a, b in zip(m_out, w_out))
+
+    def p99(xs):
+        return float(np.percentile(np.asarray(xs, np.float64), 99))
+
+    control_tpot_p99 = p99(list(c_tpot.values()))
+    mixed_short_tpot_p99 = p99([m_tpot[i] for i in short_idx
+                                if i in m_tpot])
+    control_ttft_p99 = p99(c_ttft)
+    mixed_short_ttft_p99 = p99([m_ttft[i] for i in short_idx])
+    tpot_x = mixed_short_tpot_p99 / control_tpot_p99
+    ttft_x = mixed_short_ttft_p99 / control_ttft_p99
+    gates = {
+        "tpot_flat": tpot_x <= TPOT_FLAT_X,
+        "ttft_held": ttft_x <= TTFT_HELD_X,
+        "parity": mismatches == 0,
+        "census": not census_over,
+    }
+    return {
+        "model": {"dim": DIM, "depth": DEPTH, "heads": HEADS},
+        "n_requests": requests,
+        "slots": slots,
+        "prefill_chunk": CHUNK,
+        "decode_ahead": AHEAD,
+        "kv_page_size": PAGE,
+        "prefill_bucket": BUCKET,
+        "long_prompt_tokens": [LONG_LO, LONG_HI],
+        # the new ServingStats schema, from the mixed serve
+        "n_prefill_chunks": m_summ["n_prefill_chunks"],
+        "chunk_stall_s": m_summ["chunk_stall_s"],
+        "chunk_stall_frac": m_summ["chunk_stall_frac"],
+        "longest_prompt_admitted": m_summ["longest_prompt_admitted"],
+        # gate figures: decode-latency flatness under long admissions
+        "control_tpot_s_p99": round(control_tpot_p99, 6),
+        "mixed_short_tpot_s_p99": round(mixed_short_tpot_p99, 6),
+        "tpot_p99_x": round(tpot_x, 3),
+        "tpot_target_x": TPOT_FLAT_X,
+        "control_ttft_s_p99": round(control_ttft_p99, 6),
+        "mixed_short_ttft_s_p99": round(mixed_short_ttft_p99, 6),
+        "ttft_p99_x": round(ttft_x, 3),
+        "ttft_target_x": TTFT_HELD_X,
+        "output_mismatches": mismatches,  # MUST be 0 (chunked parity)
+        "census": {"legs": census, "mode": tracker.mode,
+                   "budget": {k: CENSUS_BUDGET[k] for k in census},
+                   "over_budget": census_over},
+        "gates": gates,
+        "gates_ok": all(gates.values()),
+    }
+
+
+# Gate thresholds for the chunked_prefill leg (ISSUE 14): TPOT p99 of
+# the short requests sharing the engine with chunking long admissions
+# must stay within 15% of the no-long-prompt control — the headline
+# "decode latency stays flat" claim — and their TTFT p99 within 2x (a
+# short request may queue behind at most one in-flight chunked
+# admission's bounded chunks, never a whole-prompt prefill).
+TPOT_FLAT_X = 1.15
+TTFT_HELD_X = 2.0
+
+
 # Pinned per-leg budgets for the compile census (ISSUE 7 satellite: the
 # census is a regression GATE, not just a report — a leg exceeding its
 # budget means a program-family leak, and the bench exits nonzero).  The
@@ -523,6 +749,16 @@ CENSUS_BUDGET = {
     "sample_repeat": 0,     # and a DIFFERENT (temp, top_p, seed) config
     #                         compiles nothing either: one program family
     #                         across every sampling config
+    # the chunked-prefill family (ISSUE 14; gated by the --chunked-only
+    # block, which runs in its OWN process so the module-level pick and
+    # helper jits land in this cold set too):
+    "chunked_cold": 8,      # extend[b8] + decode window + slot_reset +
+    #                         first_pick + 4 helper jits — and NO bucket
+    #                         prefill: a chunked engine admits every
+    #                         prompt through the one extend program
+    "chunked_repeat": 0,    # a SECOND long-prompt stream (new lengths,
+    #                         new chunk counts) compiles NOTHING: prompt
+    #                         length is data, never a program shape
 }
 
 # Per-site pins for the speculative leg (ISSUE 9): the verify window is
@@ -1102,6 +1338,12 @@ def main() -> None:
                          "limit + seeded-replay gates, speculative "
                          "rejection-sampling figures) and print its own "
                          "JSON record — bench.py's `sampling` block")
+    ap.add_argument("--chunked-only", action="store_true",
+                    help="run ONLY the ISSUE 14 chunked-prefill block "
+                         "(TPOT-flat + TTFT-held + whole-prompt parity + "
+                         "census gates under a long-prompt stream) and "
+                         "print its own JSON record — bench.py's "
+                         "`chunked_prefill` block")
     args = ap.parse_args()
     if args.compile_cache_probe is not None:
         _compile_cache_probe(args.compile_cache_probe, prewarm=args.prewarm)
@@ -1120,6 +1362,24 @@ def main() -> None:
             print(f"sampling gates failed: greedy_limit_mismatches="
                   f"{rec['greedy_limit_mismatches']} replay_mismatches="
                   f"{rec['replay_mismatches']}", file=sys.stderr)
+            sys.exit(3)
+        return
+    if args.chunked_only:
+        rec = run_chunked(args.slots, 16 if QUICK else args.requests)
+        rec = {"metric": "chunked_prefill", **rec, "quick": QUICK,
+               "device": str(jax.devices()[0])}
+        print(json.dumps(rec), flush=True)
+        # the four chunked gates: decode latency that is NOT flat under
+        # long admissions, a starved short request, a token that differs
+        # from whole-prompt prefill, or a program-family leak is each a
+        # regression — fail the block AFTER the record prints
+        if not rec["gates_ok"]:
+            print(f"chunked_prefill gates failed: {rec['gates']} "
+                  f"(tpot_p99_x={rec['tpot_p99_x']} "
+                  f"ttft_p99_x={rec['ttft_p99_x']} "
+                  f"output_mismatches={rec['output_mismatches']} "
+                  f"census_over={rec['census']['over_budget']})",
+                  file=sys.stderr)
             sys.exit(3)
         return
 

@@ -43,6 +43,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_TRAIN_CENSUS": ["train_census"],
     "DTM_BENCH_SKIP_QUANT": ["quant"],
     "DTM_BENCH_SKIP_SAMPLING": ["sampling"],
+    "DTM_BENCH_SKIP_CHUNKED": ["chunked_prefill"],
 }
 
 

@@ -1,0 +1,304 @@
+"""Chunked prefill (ISSUE 14): interleaved long-prompt admission.
+
+``InferenceEngine(prefill_chunk=C)`` splits every admitted prompt into
+fixed C-token chunks run through the ONE paged ``extend[b{C}]`` program,
+one chunk per engine iteration at the prefill-overlap seam.  The
+decisive properties:
+
+* PARITY — chunked output is token-identical to the whole-prompt engine,
+  greedy and sampled, at every chunk size, with and without radix
+  sharing (the chunk schedule changes WHEN cache rows fill, never what
+  they hold).
+* LONG PROMPTS — prompts past every bucket admit (up to
+  ``max_len - max_new``) with zero new compiled programs; the submit
+  error with chunking OFF names ``prefill_chunk=`` as the fix.
+* PREFILLING — the transient state is invisible to decode (co-resident
+  streams are unchanged), survives ``close()`` mid-chunk, and drains
+  its pages.
+* RADIX BOUNDARY — a partial radix hit landing exactly on a chunk
+  boundary resumes at the divergence page: parity with the cold serve,
+  no double-prefilled pages, refcounts drain to zero.
+* DETERMINISM — one ``serving-admit`` chaos event per admission attempt
+  (stall retries do not re-fire), chunk dispatches add no events.
+* STATS — ``n_prefill_chunks`` / ``chunk_stall_s`` /
+  ``longest_prompt_admitted`` are exact, merge correctly, and the
+  record stays strict-JSON.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
+    InferenceEngine,
+    SamplingParams,
+    ServingStats,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
+
+KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+
+PROMPTS = [
+    [1, 2, 3, 4, 5],
+    [7, 8],
+    [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+    [3, 1, 4, 1, 5, 9, 2, 6],
+]
+
+
+def _model_and_params(seed=0, **over):
+    model = get_model("causal_lm", **{**KW, **over})
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("buckets", (8, 16))
+    kw.setdefault("kv_page_size", 4)
+    return InferenceEngine(model, params, **kw)
+
+
+def _serve(model, params, prompts=PROMPTS, max_new=6, sampling=None, **kw):
+    eng = _engine(model, params, **kw)
+    if not isinstance(sampling, (list, tuple)):
+        sampling = [sampling] * len(prompts)
+    reqs = [eng.submit(np.asarray(p, np.int32), max_new=max_new, sampling=s)
+            for p, s in zip(prompts, sampling)]
+    eng.run(max_steps=2000)
+    return eng, reqs
+
+
+def _outputs(reqs):
+    return [(r.status, tuple(r.generated)) for r in reqs]
+
+
+# ----------------------------------------------------------------------
+# construction-time contract
+
+
+def test_prefill_chunk_validation():
+    model, params = _model_and_params()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _engine(model, params, prefill_chunk=-1)
+    with pytest.raises(ValueError, match="kv_page_size"):
+        InferenceEngine(model, params, slots=2, max_len=48, buckets=(8,),
+                        prefill_chunk=4)  # dense layout
+    with pytest.raises(ValueError, match="max_len"):
+        _engine(model, params, prefill_chunk=64)
+    with pytest.raises(ValueError, match="prefix"):
+        _engine(model, params, prefill_chunk=4, prefix_cache_bytes=1 << 20)
+    # a chunk-lifted scheduler wired to a whole-prompt engine is the
+    # drift bug the agreement check exists to catch
+    sched = FIFOScheduler(max_len=48, buckets=(8, 16), chunked_prefill=True)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        InferenceEngine(model, params, slots=2, max_len=48,
+                        kv_page_size=4, scheduler=sched)
+
+
+# ----------------------------------------------------------------------
+# parity: the chunk schedule never changes a token
+
+
+@pytest.mark.parametrize("chunk", [2, 5, 16])
+def test_chunked_matches_whole_prompt_greedy(chunk):
+    model, params = _model_and_params(seed=1)
+    _, ref = _serve(model, params)
+    eng, got = _serve(model, params, prefill_chunk=chunk)
+    assert _outputs(got) == _outputs(ref)
+    assert all(r.status == "done" for r in got)
+    # exact chunk count needs radix OFF (sharing legitimately skips the
+    # matched-prefix chunks — the boundary test pins that arithmetic)
+    eng2, got2 = _serve(model, params, prefill_chunk=chunk,
+                        radix_cache=False)
+    assert _outputs(got2) == _outputs(ref)
+    s = eng2.stats.summary()
+    assert s["n_prefill_chunks"] == sum(
+        -(-len(p) // chunk) for p in PROMPTS)
+
+
+def test_chunked_matches_whole_prompt_sampled():
+    """Seeded sampled streams are pure functions of the seed — the chunk
+    schedule must not perturb the key schedule or the first pick."""
+    model, params = _model_and_params(seed=2)
+    mix = [SamplingParams(temperature=0.9, top_p=0.85, top_k=6, seed=i * 3 + 1)
+           for i in range(len(PROMPTS) - 1)] + [None]
+    _, ref = _serve(model, params, sampling=mix)
+    _, got = _serve(model, params, sampling=mix, prefill_chunk=3)
+    assert _outputs(got) == _outputs(ref)
+    lp_ref = [list(r.logprobs) for r in ref]
+    lp_got = [list(r.logprobs) for r in got]
+    for a, b in zip(lp_got, lp_ref):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# long prompts: past every bucket, one program family
+
+
+def test_long_prompt_admits_and_census_pinned():
+    model, params = _model_and_params(seed=3)
+    eng = _engine(model, params, prefill_chunk=4)
+    eng.prewarm()
+    before = eng._compile.snapshot()
+    long_prompt = list(range(1, 41))                 # 40 tokens, bucket 16
+    reqs = [eng.submit(np.asarray(long_prompt, np.int32), max_new=5),
+            eng.submit([1, 2, 3], max_new=5)]
+    eng.run(max_steps=2000)
+    assert all(r.status == "done" and len(r.generated) == 5 for r in reqs)
+    d = CompileTracker.delta(eng._compile.snapshot(), before)
+    assert d["n_compiled_programs"] == 0, d          # extend[b4] prewarmed
+    s = eng.stats.summary()
+    assert s["longest_prompt_admitted"] == 40
+    assert s["n_prefill_chunks"] == 10 + 1           # ceil(40/4) + ceil(3/4)
+    assert s["chunk_stall_s"] > 0.0
+    eng.close()
+
+
+def test_scheduler_submit_error_paths():
+    """Chunking OFF: an over-bucket prompt's error names prefill_chunk=
+    as the fix.  Chunking ON: the same prompt admits, and the cache-length
+    bound (max_len - max_new) still holds."""
+    off = FIFOScheduler(max_len=48, buckets=(8, 16))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        off.submit(list(range(20)), max_new=4)
+    on = FIFOScheduler(max_len=48, buckets=(8, 16), chunked_prefill=True)
+    req = on.submit(list(range(40)), max_new=8)      # 40 + 8 = max_len
+    assert req.bucket == 16                          # capped label
+    with pytest.raises(ValueError, match="cache length"):
+        on.submit(list(range(41)), max_new=8)        # 41 + 8 > max_len
+
+
+# ----------------------------------------------------------------------
+# PREFILLING state: invisible to decode, safe to close, pages drain
+
+
+def test_close_mid_chunking_drains_pages():
+    model, params = _model_and_params(seed=4)
+    eng = _engine(model, params, prefill_chunk=2, radix_cache=False)
+    req = eng.submit(np.asarray(list(range(1, 31)), np.int32), max_new=4)
+    eng.step()                                       # admit + first chunk
+    assert req.status == "prefilling"
+    assert eng._slot_prefill[0] is not None
+    eng.close()
+    assert req.status == "cancelled" and req.engine_fault
+    assert eng._pool.allocated == 0                  # every page came back
+
+
+def test_pool_drains_after_chunked_run():
+    model, params = _model_and_params(seed=5)
+    eng, reqs = _serve(model, params, prefill_chunk=3, radix_cache=False)
+    assert all(r.status == "done" for r in reqs)
+    assert eng._pool.allocated == 0
+    eng.close()
+
+
+def test_chunked_overcommit_stalls_then_serves():
+    """A pool too small for both slots' worst case: the second admission
+    parks on the dry pool and retries — every request still finishes and
+    exactly one serving-admit chaos event fired per admission ATTEMPT
+    (the stall retry does not re-fire)."""
+    model, params = _model_and_params(seed=6)
+    plan = FaultPlan(faults=(
+        FaultSpec(site="serving-admit", kind="poison", at=(2,)),))
+    inj = FaultInjector(plan)                        # event 2 = 3rd attempt
+    eng = _engine(model, params, prefill_chunk=4, radix_cache=False,
+                  max_len=32, kv_pages=9, chaos=inj)
+    reqs = [eng.submit(np.asarray(p, np.int32), max_new=4)
+            for p in ([1] * 20, [2] * 20, [3] * 5)]
+    eng.run(max_steps=4000)
+    statuses = [r.status for r in reqs]
+    assert statuses[0] == "done" and statuses[1] == "done"
+    # the THIRD admission attempt (not a stall retry of an earlier one)
+    # ate the injected fault — stall retries skipping the chaos site is
+    # exactly what keeps this index stable
+    assert statuses[2] == "failed" and "ChaosFault" in reqs[2].error
+    assert eng._pool.allocated == 0
+    eng.close()
+
+
+# ----------------------------------------------------------------------
+# radix partial hit landing exactly on a chunk boundary
+
+
+def test_radix_hit_on_chunk_boundary_parity_and_refcounts():
+    """Two waves share a 12-token prefix; page size 4, chunk 4: the
+    second wave's match lands exactly on a chunk boundary (done = 12,
+    divergence at page 3).  Output must equal the cold serve, no page is
+    prefilled twice (radix_hit_tokens says the extend skipped the
+    match), and every trie refcount drains to zero after retirement."""
+    model, params = _model_and_params(seed=7)
+    shared = list(range(1, 13))                      # 3 whole pages
+    wave = [shared + [13, 14, 15], shared + [9, 9], [5, 5, 5]]
+
+    # slots=1 serializes the wave so request 1 admits AFTER request 0's
+    # donation — its 12-token match is the chunk-boundary landing
+    cold_eng, cold = _serve(model, params, prompts=wave, max_new=5,
+                            radix_cache=False, prefill_chunk=4, slots=1)
+    eng, got = _serve(model, params, prompts=wave, max_new=5,
+                      radix_cache=True, prefill_chunk=4, slots=1)
+    assert _outputs(got) == _outputs(cold)
+    s = eng.stats.summary()
+    assert s["radix_hits"] >= 1
+    # the matched pages were SKIPPED, not re-extended: chunks dispatched
+    # for request 1 cover only its suffix past the 12-token boundary
+    assert got[1].radix_tokens == 12
+    chunks_cold = cold_eng.stats.summary()["n_prefill_chunks"]
+    assert s["n_prefill_chunks"] == chunks_cold - 3  # 12/4 skipped chunks
+    # refcounts drain: after the run only the trie's own donations hold
+    # pages, and every node's refcount is zero (nothing is pinned)
+    assert eng._pool.allocated == eng._radix.n_blocks
+    stack = [eng._radix.root]
+    while stack:
+        node = stack.pop()
+        assert node.ref == 0
+        stack.extend(node.children.values())
+    eng.close()
+
+
+# ----------------------------------------------------------------------
+# stats: exact counters, merge, strict JSON
+
+
+def test_chunked_stats_merge_and_strict_json():
+    model, params = _model_and_params(seed=8)
+    eng_a, _ = _serve(model, params, prompts=[[1, 2, 3, 4, 5]],
+                      prefill_chunk=2)
+    eng_b, _ = _serve(model, params, prompts=[list(range(1, 20))],
+                      prefill_chunk=2)
+    plain = ServingStats(slots=2)                    # no chunk activity
+    a, b = eng_a.stats, eng_b.stats
+    for rec, want_chunks, want_longest in ((a, 3, 5), (b, 10, 19)):
+        s = rec.summary()
+        assert s["n_prefill_chunks"] == want_chunks
+        assert s["longest_prompt_admitted"] == want_longest
+        assert s["chunk_stall_s"] >= 0.0 and s["chunk_stall_frac"] >= 0.0
+    merged = ServingStats.merge([a, b, plain])
+    assert merged["n_prefill_chunks"] == 13
+    assert merged["longest_prompt_admitted"] == 19   # max, not sum
+    assert merged["chunk_stall_s"] == pytest.approx(
+        a.summary()["chunk_stall_s"] + b.summary()["chunk_stall_s"], abs=1e-5)
+    # the idle record reports the null states, never NaN
+    ps = plain.summary()
+    assert ps["n_prefill_chunks"] == 0
+    assert ps["chunk_stall_frac"] is None
+    assert ps["longest_prompt_admitted"] is None
+    # strict JSON round-trip: no NaN/Inf anywhere in either record shape
+    for payload in (merged, ps):
+        assert json.loads(
+            json.dumps(payload, allow_nan=False)) == json.loads(
+                json.dumps(payload, allow_nan=False))
+    eng_a.close()
+    eng_b.close()

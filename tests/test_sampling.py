@@ -12,7 +12,8 @@ The decisive properties:
   the engine's greedy output across layouts × decode_ahead ×
   ±speculative: sampling rows ride the SAME program, selected by data.
 * ONE PROGRAM FAMILY — after prewarm, serving any mix of per-request
-  ``(temperature, top_p, seed)`` configs compiles ZERO new programs.
+  ``(temperature, top_p, top_k, seed)`` configs compiles ZERO new
+  programs (top-k rides a per-slot int32 data plane — ISSUE 14).
 * DISTRIBUTION — the speculative verify's rejection sampling (accept a
   draft with prob ``p_target(d)``, resample the masked residual on
   reject) emits the target sampling distribution exactly; chi-squared
@@ -134,6 +135,60 @@ def test_sampling_params_validation_and_key():
         SamplingParams(temperature=1.0, seed=5).key(), base_key(5))
 
 
+def test_top_k_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingParams(temperature=1.0, top_k=-1)
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingParams(temperature=1.0, top_k=True)
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingParams(temperature=1.0, top_k=2.5)
+    # top_k filters a sampling distribution: meaningless at temperature 0
+    with pytest.raises(ValueError, match="temperature > 0"):
+        SamplingParams(temperature=0.0, top_k=3)
+    assert SamplingParams(temperature=1.0, top_k=5).top_k == 5
+
+
+def test_filter_topk_rows_per_row_support():
+    """The data-plane top-k filter (ISSUE 14): each ROW keeps its own k
+    highest logits and floors the rest; k=0 and k>=vocab are per-row
+    no-ops (the off states), all in one (B, V) program."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
+        _filter_topk_rows,
+    )
+    rng = np.random.default_rng(0)
+    raw = rng.normal(size=(4, 16)).astype(np.float32)  # no ties w.h.p.
+    ks = jnp.asarray([0, 1, 3, 16], jnp.int32)
+    out = np.asarray(_filter_topk_rows(jnp.asarray(raw), ks))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_array_equal(out[0], raw[0])      # 0 = filter off
+    np.testing.assert_array_equal(out[3], raw[3])      # k >= vocab = off
+    for row, k in ((1, 1), (2, 3)):
+        keep = np.zeros(16, bool)
+        keep[np.argsort(raw[row])[-k:]] = True
+        np.testing.assert_array_equal(out[row][keep], raw[row][keep])
+        assert (out[row][~keep] == neg).all(), (row, k)
+
+
+def test_top_k_one_is_argmax_and_vocab_k_is_noop():
+    """``top_k=1`` at ANY temperature is argmax — token-identical to the
+    greedy engine (seed inert in effect); ``top_k >= vocab`` leaves the
+    distribution untouched — stream-identical to the same seed without
+    the filter.  Both ride the same compiled window as every other row."""
+    model, params = _model_and_params(seed=8)
+    want, _ = _serve(model, params)                   # greedy reference
+    got, _ = _serve(model, params,
+                    sampling=SamplingParams(temperature=1.7, top_k=1,
+                                            seed=99))
+    assert got == want
+    v = KW["num_classes"]
+    base, _ = _serve(model, params,
+                     sampling=SamplingParams(temperature=0.9, seed=5))
+    full, _ = _serve(model, params,
+                     sampling=SamplingParams(temperature=0.9, top_k=v,
+                                             seed=5))
+    assert base == full
+
+
 def test_scheduler_submit_rejects_non_params():
     sched = FIFOScheduler(max_len=32, buckets=(8,))
     with pytest.raises(ValueError, match="SamplingParams"):
@@ -226,8 +281,8 @@ def test_spec_sampled_replay_token_identical():
 def test_zero_new_programs_across_sampling_configs():
     model, params = _model_and_params(seed=5)
     mixes = [None, SamplingParams(temperature=0.7, top_p=0.9, seed=1),
-             SamplingParams(temperature=1.3, seed=9),
-             SamplingParams(temperature=0.4, top_p=0.3, seed=42)]
+             SamplingParams(temperature=1.3, top_k=4, seed=9),
+             SamplingParams(temperature=0.4, top_p=0.3, top_k=7, seed=42)]
     for kw in ({"decode_ahead": 4},
                {"speculative": "ngram", "draft_len": 3}):
         eng = _engine(model, params, **kw)
@@ -281,14 +336,15 @@ def test_verify_rejection_sampling_matches_target_distribution():
     # reference logits at the position the verify's lane 0 samples
     _, logits0 = make_decode_step(model, max_len)(params, cache0, pend)
     verify = jax.jit(functools.partial(
-        _verify_sample_core, model, max_len=max_len, top_k=0, pad_id=0))
+        _verify_sample_core, model, max_len=max_len, pad_id=0))
 
     for temp, topp, pick, label in ((1.2, 0.0, "hi", "plain/mode-draft"),
                                     (0.9, 0.85, "lo", "nucleus/worst-draft")):
         temps = jnp.full((B,), temp, jnp.float32)
         topps = jnp.full((B,), topp, jnp.float32)
         p = np.asarray(jax.nn.softmax(
-            _tempered_rows(logits0[:1], temps[:1], topps[:1], 0)))[0]
+            _tempered_rows(logits0[:1], temps[:1], topps[:1],
+                           jnp.zeros((1,), jnp.int32))))[0]
         draft = int(np.argmax(p) if pick == "hi" else np.argmin(p))
         chunk = np.zeros((B, 2), np.int32)
         chunk[:, 0] = np.asarray(pend)
@@ -300,7 +356,8 @@ def test_verify_rejection_sampling_matches_target_distribution():
             _, toks, logps, acc, _ = verify(
                 params, cache0, jnp.asarray(chunk),
                 jnp.ones((B,), jnp.int32), jnp.ones((B,), bool),
-                temps, topps, keys, jnp.zeros((B,), jnp.int32))
+                temps, topps, jnp.zeros((B,), jnp.int32), keys,
+                jnp.zeros((B,), jnp.int32))
             np.add.at(counts, np.asarray(toks)[:, 0], 1)
         assert counts.sum() == B * reps >= 10_000
         _chi2_gate(counts, p, label)
