@@ -46,10 +46,16 @@ per-request temperature/top_p/seed decode — the greedy-limit and
 seeded-replay token-identity gates plus the speculative
 rejection-sampling acceptance/throughput figures —
 scripts/bench_serving.py --sampling-only, skip with
-DTM_BENCH_SKIP_SAMPLING).  The tp_serving, train_census, quant,
-sampling, and serving-subprocess gates (compile census budgets, the
-ISSUE 11 telemetry <=2% overhead bar, SLO/goodput counter arithmetic)
-fail the bench run (exit 3) on breach, after the record prints.
+DTM_BENCH_SKIP_SAMPLING), and an ``slo_daemon`` block (ISSUE 15: the
+daemonized tier under an OPEN-loop Poisson generator — goodput under
+overload with deadline shedding, a chaos pump-kill leg gating the
+failover goodput floor / zero drops / exactly-once streams, and the
+drain-clean lifecycle — scripts/bench_slo.py, skip with
+DTM_BENCH_SKIP_SLO_DAEMON).  The tp_serving, train_census, quant,
+sampling, slo_daemon, and serving-subprocess gates (compile census
+budgets, the ISSUE 11 telemetry <=2% overhead bar, SLO/goodput counter
+arithmetic) fail the bench run (exit 3) on breach, after the record
+prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -740,6 +746,52 @@ def main() -> None:
             census_gate_rc = 1
             print(f"bench: train_census phase failed: {e!r}", file=sys.stderr)
 
+    # Phase 10 — the daemonized-tier SLO/goodput harness (ISSUE 15): an
+    # OPEN-loop Poisson generator against ServingDaemon (thread-per-
+    # replica pumps, policy admission) measuring goodput under an
+    # unloaded control, a 4x-capacity overload with deadline shedding,
+    # and a chaos leg that kills one pump mid-wave — gating exact
+    # conservation, exactly-once streams, the failover goodput floor,
+    # and a drain that leaves zero open spans and refcount-zero pools.
+    # A breach FAILS the bench run (exit 3) after the record prints.
+    # Runs scripts/bench_slo.py in a SUBPROCESS on the CPU backend.
+    # Skippable (DTM_BENCH_SKIP_SLO_DAEMON).
+    slo_daemon = None
+    slo_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_SLO_DAEMON"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_slo.py")],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "slo_daemon":
+                    slo_daemon = rec
+            if slo_daemon is None or out.returncode != 0:
+                slo_gate_rc = out.returncode or 1
+                print(
+                    f"bench: slo_daemon subprocess "
+                    f"{'produced no record' if slo_daemon is None else 'FAILED (goodput/conservation/drain gate breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            slo_gate_rc = 1
+            print(f"bench: slo_daemon phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -847,6 +899,10 @@ def main() -> None:
         result["chunked_prefill"] = {
             k: v for k, v in chunked.items() if k != "metric"
         }
+    if slo_daemon is not None:
+        result["slo_daemon"] = {
+            k: v for k, v in slo_daemon.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -860,7 +916,7 @@ def main() -> None:
     # arithmetic) fail the RUN, not just their block — after the record
     # prints so the numbers are never lost with the verdict
     if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
-            or sampling_gate_rc or chunked_gate_rc):
+            or sampling_gate_rc or chunked_gate_rc or slo_gate_rc):
         import sys
 
         sys.exit(3)

@@ -50,6 +50,15 @@ Mesh-TensorFlow separation of device program from execution driver
   exactly-once token delivery for greedy AND seeded-sampled decode), and
   live weight hot swap (drain → ``swap_params`` → re-admit, one replica
   at a time, validated through ``restore_latest_intact``)
+* :class:`~.daemon.ServingDaemon` / :class:`~.daemon.DaemonRequest` —
+  the daemonized tier (ISSUE 15): one pump thread per replica turns the
+  step-pumped router into a long-lived service with thread-safe
+  ``submit()``/``stream()``, per-request-ordered delivery, an external
+  pump-wedge watchdog, and graceful ``drain``/``close``; admission order
+  and shed-at-submit are pluggable via serving/policies.py
+  (:class:`~.policies.FIFOPolicy`, :class:`~.policies.PriorityPolicy`,
+  :class:`~.policies.DeadlineAwarePolicy` raising
+  :class:`~.policies.SLOUnmeetable`)
 
 Observability (ISSUE 6): pass ``tracer=`` (utils/tracing.Tracer) to the
 engine and every request records a span tree (submit → queue → admit/
@@ -72,6 +81,10 @@ met/miss rule; ``scripts/telemetry_report.py`` renders the time-series).
 See docs/SERVING.md for the architecture and knobs.
 """
 
+from distributed_tensorflow_ibm_mnist_tpu.serving.daemon import (
+    DaemonRequest,
+    ServingDaemon,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.drafter import NgramDrafter
 from distributed_tensorflow_ibm_mnist_tpu.serving.engine import (
     EngineStalled,
@@ -81,6 +94,13 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
     KVPagePool,
     init_paged_cache,
     pages_needed,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving.policies import (
+    AdmissionPolicy,
+    DeadlineAwarePolicy,
+    FIFOPolicy,
+    PriorityPolicy,
+    SLOUnmeetable,
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
@@ -103,20 +123,27 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.stats import (
 )
 
 __all__ = [
+    "AdmissionPolicy",
+    "DaemonRequest",
+    "DeadlineAwarePolicy",
     "EngineStalled",
+    "FIFOPolicy",
     "InferenceEngine",
     "FIFOScheduler",
     "KVPagePool",
     "NgramDrafter",
     "NoHealthyReplica",
     "PrefixCache",
+    "PriorityPolicy",
     "QueueFull",
     "RadixCache",
     "Replica",
     "Request",
     "Router",
     "RouterRequest",
+    "SLOUnmeetable",
     "SamplingParams",
+    "ServingDaemon",
     "ServingStats",
     "WeightWatcher",
     "init_paged_cache",

@@ -161,6 +161,16 @@ give supervisors graceful-shutdown semantics.  Chaos sites
 per-site event indices are unchanged by decode-ahead and overlap (one
 ``serving-admit`` event per admission attempt in FIFO order, one
 ``serving-step`` event per window dispatch).
+
+Thread model: the engine itself is single-threaded — ONE thread (the
+caller's loop, or one daemon pump thread per replica in
+serving/daemon.py) drives ``step()``/``step_chunk()`` and owns every
+slot/cache mutation.  Cross-thread ``submit()`` is the daemon's job: it
+serializes admissions under its tier lock and the scheduler's deque
+append/popleft are atomic under CPython, so the pump can pop while a
+producer appends.  The only engine state other threads read directly is
+:attr:`heartbeat_t` (a single float write, torn-read-free) — the
+external liveness probe for a wedged pump.
 """
 
 from __future__ import annotations
@@ -948,6 +958,16 @@ class InferenceEngine:
     def has_work(self) -> bool:
         return (self.occupied > 0 or len(self.scheduler) > 0
                 or len(self._pending) > 0)
+
+    @property
+    def heartbeat_t(self) -> float | None:
+        """Monotonic timestamp of the engine's last real progress (a token
+        produced), or None before the first.  The EXTERNAL liveness signal:
+        ``stall_timeout_s`` is judged inside :meth:`step`, so a pump thread
+        wedged mid-step can never trip it — the daemon's watchdog thread
+        reads this instead and declares the replica dead when it freezes
+        while work is in flight (serving/daemon.py, serving/replica.py)."""
+        return self._last_progress_ever
 
     def _req_sampling(self, req: Request):
         """``(temperature, top_p, top_k, base_key)`` resolved for ``req``

@@ -147,8 +147,8 @@ class Replica:
         (None only if it never made any) — instead of vanishing from the
         dict."""
         e = self.engine
-        if e is not None and e._last_progress_ever is not None:
-            self._heartbeat_t = e._last_progress_ever
+        if e is not None and e.heartbeat_t is not None:
+            self._heartbeat_t = e.heartbeat_t
         return {
             "state": self.state,
             "alive": self.alive,

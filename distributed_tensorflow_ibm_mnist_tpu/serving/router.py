@@ -57,8 +57,13 @@ pattern: zero chaos instructions when unwired.
 Tracing: all replicas share ONE tracer; each gets its own track
 (``replica <i>``), so N host loops render as N lanes, with
 ``replica_failed`` / ``failover_redispatch`` / ``weight_swap`` instants on
-the lane they happened to.  The router is single-threaded like the engine:
-one thread calls submit/step/close.
+the lane they happened to.  The router itself is single-threaded like the
+engine — one thread calls submit/step/close — and that is still how the
+step-pumped benchmarks drive it.  The daemonized tier
+(serving/daemon.py) is the concurrency seam: it serializes every
+router-level mutation (submit/dispatch, failover, orphan retry) under
+its tier lock and gives each replica its own pump thread, so the router
+never needs internal locks of its own.
 """
 
 from __future__ import annotations
@@ -347,20 +352,36 @@ class Router:
         for rep in self.replicas:
             if rep.state == FAILED or not rep.alive:
                 continue
-            if (rep.state == HEALTHY and self._probe is not None
-                    and not self._probe(rep)):
-                self._fail_replica(rep, RuntimeError("health probe failed"))
-                continue
-            if not rep.engine.has_work:
-                continue
             try:
+                if (rep.state == HEALTHY and self._probe is not None
+                        and not self._probe(rep)):
+                    raise RuntimeError("health probe failed")
+                if not rep.engine.has_work:
+                    continue
                 produced += rep.engine.step()
             except Exception as e:
                 # per-request faults never propagate from step() (the
                 # single-engine isolation contract) — anything that does
                 # is engine-wide: EngineStalled after the watchdog, a raw
-                # decode fault without one
-                self._fail_replica(rep, e)
+                # decode fault without one, a probe that raised instead of
+                # returning False.  The blast radius is ONE replica: fail
+                # it over and keep pumping the siblings this same
+                # iteration (a raising probe used to propagate out of
+                # step() and starve every replica after it in the loop).
+                if rep.state != FAILED:
+                    try:
+                        self._fail_replica(rep, e)
+                    except Exception as fe:
+                        # failover machinery itself failing (a close that
+                        # raises mid-harvest) still must not starve
+                        # siblings; the replica is already marked FAILED
+                        # (first statement of _fail_replica), so nothing
+                        # re-dispatches to it
+                        if self._tracer is not None:
+                            self._tracer.instant(
+                                "failover_error", cat="router", tid=rep.tid,
+                                replica=rep.index,
+                                error=f"{type(fe).__name__}: {fe}")
         if self._orphans:
             self._retry_orphans()
         if self._telemetry is not None:
@@ -392,8 +413,16 @@ class Router:
         # close() converts everything the engine had accepted into
         # engine_fault-marked terminal records (failed in-flight rows were
         # already marked by the fault path itself); harvest = exactly the
-        # collateral, never a request's own failure
-        rep.close()
+        # collateral, never a request's own failure.  A close that raises
+        # (the engine is already sick) must not abort the harvest —
+        # whatever made it into ``completed`` still gets re-dispatched.
+        try:
+            rep.close()
+        except Exception as ce:
+            if self._tracer is not None:
+                self._tracer.instant("replica_close_error", cat="router",
+                                     tid=rep.tid, replica=rep.index,
+                                     error=f"{type(ce).__name__}: {ce}")
         casualties = [
             self._owner[id(req)]
             for req in rep.engine.completed

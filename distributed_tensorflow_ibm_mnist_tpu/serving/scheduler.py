@@ -21,6 +21,14 @@ Three jobs:
   submit).  Overdue QUEUED requests are cancelled at pop time (never
   admitted — prefilling a request that cannot finish wastes the slot);
   overdue RUNNING rows are cancelled by the engine's per-iteration sweep.
+
+Thread model: the queue is a ``collections.deque``, whose ``append`` and
+``popleft`` are each atomic under CPython — a daemon pump thread can pop
+while a producer appends without a scheduler-level lock.  What is NOT
+atomic is the bounded-queue check-then-append in ``submit``: concurrent
+submitters must serialize it externally, which the daemonized tier does
+under its tier lock (serving/daemon.py) — single-threaded callers get it
+for free.
 """
 
 from __future__ import annotations

@@ -71,7 +71,9 @@ latency — a deadline kill is not a service time).
 
 from __future__ import annotations
 
+import contextlib
 import random
+import threading
 
 import numpy as np
 
@@ -113,6 +115,15 @@ class ServingStats:
     once per retired request; :meth:`summary` folds everything into one
     flat dict and :meth:`emit` writes it through a :class:`MetricWriter`
     (non-finite values are sanitized to null by the writer itself).
+
+    Thread model (the daemonized tier — serving/daemon.py): each stats
+    object has ONE writer — the engine that owns it, driven by exactly one
+    pump thread — but is READ from other threads (``merge``/``summary``/
+    ``vitals`` on the daemon's control and telemetry paths).  Every
+    mutator and every snapshot therefore holds ``self._lock`` (an RLock,
+    uncontended in the single-threaded case), so a reader can never see a
+    half-applied :meth:`add` (request counted, reservoir/SLO counters not
+    yet) and :meth:`merge` folds N live records without torn counters.
     """
 
     def __init__(self, slots: int, decode_ahead: int = 1,
@@ -121,6 +132,7 @@ class ServingStats:
             raise ValueError(f"sample_cap must be >= 1, got {sample_cap}")
         self.slots = slots
         self.decode_ahead = decode_ahead
+        self._lock = threading.RLock()
         # bounded percentile-sample reservoir (Algorithm R; see module
         # docstring).  Counters below are EXACT regardless of the cap;
         # only the percentile samples are subject to reservoir sampling.
@@ -203,28 +215,31 @@ class ServingStats:
         #   engine quantizes at upload — ISSUE 12); stamped with memory()
 
     def tick(self, occupied: int, dt: float, decoded: bool = False) -> None:
-        self._occ_time += occupied * dt
-        self._busy_time += dt
-        if decoded:
-            self._decode_steps += 1
+        with self._lock:
+            self._occ_time += occupied * dt
+            self._busy_time += dt
+            if decoded:
+                self._decode_steps += 1
 
     def window(self, dispatch_s: float, readback_s: float, steps: int,
                waste: int) -> None:
         """One decode-ahead window: ``steps`` = occupied slots × window
         length dispatched, ``waste`` = the subset discarded on the host
         (tokens decoded past a row's EOS/budget inside the window)."""
-        self._windows += 1
-        self._dispatch_time += dispatch_s
-        self._readback_time += readback_s
-        self._window_steps += steps
-        self._waste_steps += waste
+        with self._lock:
+            self._windows += 1
+            self._dispatch_time += dispatch_s
+            self._readback_time += readback_s
+            self._window_steps += steps
+            self._waste_steps += waste
 
     def prefix(self, hit: bool) -> None:
         """One prefix-cache lookup (hit = prefill skipped entirely)."""
-        if hit:
-            self._prefix_hits += 1
-        else:
-            self._prefix_misses += 1
+        with self._lock:
+            if hit:
+                self._prefix_hits += 1
+            else:
+                self._prefix_misses += 1
 
     def spec(self, drafted: int, accepted: int, corrected: int = 1) -> None:
         """One slot's outcome in one speculative verify window: ``drafted``
@@ -232,9 +247,10 @@ class ServingStats:
         model's argmax, plus ``corrected`` free correction/continuation
         tokens (1 per verified slot — the model's own next token after the
         accepted prefix, emitted whether or not anything was accepted)."""
-        self._spec_drafted += int(drafted)
-        self._spec_accepted += int(accepted)
-        self._spec_corrected += int(corrected)
+        with self._lock:
+            self._spec_drafted += int(drafted)
+            self._spec_accepted += int(accepted)
+            self._spec_corrected += int(corrected)
 
     def prefix_oversized(self, count: int) -> None:
         """Absolute count of PrefixCache.put refusals (entry > max_bytes);
@@ -246,28 +262,31 @@ class ServingStats:
         """One page-pool occupancy sample (the paged engine calls this per
         step): live/total allocatable pages, the page size in tokens, and
         the cross-layer bytes one page occupies (kv_pool.pool_page_bytes)."""
-        self._kv_pages_live = int(pages_live)
-        self._kv_pages_peak = max(self._kv_pages_peak, int(pages_live))
-        self._kv_pages_total = int(pages_total)
-        self._kv_page_size = int(page_size)
-        self._kv_page_bytes = int(page_bytes)
+        with self._lock:
+            self._kv_pages_live = int(pages_live)
+            self._kv_pages_peak = max(self._kv_pages_peak, int(pages_live))
+            self._kv_pages_total = int(pages_total)
+            self._kv_page_size = int(page_size)
+            self._kv_page_bytes = int(page_bytes)
 
     def radix(self, hit: bool, tokens: int = 0) -> None:
         """One admission's radix-trie match outcome: ``tokens`` = shared
         prefix length whose prefill was skipped (whole pages only)."""
-        if hit:
-            self._radix_hits += 1
-            self._radix_hit_tokens += int(tokens)
-        else:
-            self._radix_misses += 1
+        with self._lock:
+            if hit:
+                self._radix_hits += 1
+                self._radix_hit_tokens += int(tokens)
+            else:
+                self._radix_misses += 1
 
     def chunk(self, stall_s: float) -> None:
         """One chunked-prefill dispatch (ISSUE 14): ``stall_s`` = wall
         seconds the dispatch occupied the host loop — the bounded
         per-iteration decode-latency cost the chunked_prefill bench leg
         gates on."""
-        self._prefill_chunks += 1
-        self._chunk_stall_s += float(stall_s)
+        with self._lock:
+            self._prefill_chunks += 1
+            self._chunk_stall_s += float(stall_s)
 
     def prompt_admitted(self, n_tokens: int) -> None:
         """One admission's prompt length (chunked engines call this at
@@ -296,6 +315,10 @@ class ServingStats:
         self._compile = delta
 
     def add(self, req: Request) -> None:
+        with self._lock:
+            self._add_locked(req)
+
+    def _add_locked(self, req: Request) -> None:
         self._n_requests += 1
         if req.status == "done":
             self._n_done += 1
@@ -348,6 +371,10 @@ class ServingStats:
     def summary(self) -> dict:
         # counters are exact; ttft/latency percentiles are computed over
         # the bounded reservoir (exact below sample_cap)
+        with self._lock:
+            return self._summary_locked()
+
+    def _summary_locked(self) -> dict:
         done = [r for r in self.requests if r.status == "done"]
         ttft = [r.first_token_t - r.submit_t for r in self.requests
                 if r.first_token_t is not None]
@@ -493,6 +520,10 @@ class ServingStats:
         """Cheap live subset for the telemetry health sampler
         (utils/telemetry.Telemetry): counters and rates only, no
         percentile work, safe to call every sampling interval."""
+        with self._lock:
+            return self._vitals_locked()
+
+    def _vitals_locked(self) -> dict:
         p_total = self._prefix_hits + self._prefix_misses
         r_total = self._radix_hits + self._radix_misses
         return {
@@ -539,7 +570,22 @@ class ServingStats:
         SLO counters sum and ``slo_met_rate``/``goodput_rps`` re-derive
         over the merged totals, so the cluster goodput is met-requests
         per second of the CLUSTER's busy window, not a mean of rates.
+
+        Safe against LIVE records: every record's lock is held for the
+        whole fold (the daemonized tier merges while pump threads are
+        still retiring requests), so the rollup is a consistent snapshot
+        — no counter is read mid-:meth:`add`.
         """
+        with contextlib.ExitStack() as stack:
+            # canonical acquisition order: two concurrent merges over
+            # overlapping record sets can never deadlock (RLock, so a
+            # duplicate record in the list re-enters harmlessly)
+            for rec in sorted(records, key=id):
+                stack.enter_context(rec._lock)
+            return cls._merge_locked(records)
+
+    @classmethod
+    def _merge_locked(cls, records: list["ServingStats"]) -> dict:
         reqs = [r for rec in records for r in rec.requests]
         done = [r for r in reqs if r.status == "done"]
         ttft = [r.first_token_t - r.submit_t for r in reqs

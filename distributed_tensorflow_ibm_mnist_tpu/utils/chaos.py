@@ -55,6 +55,14 @@ Injection sites (consulted by the subsystems named in parentheses):
                           its OLD weights (still consistent — the swap is
                           all-or-nothing) and the watcher retries at the
                           next poll.
+``daemon-pump``           one event per pump-thread activation
+                          (serving/daemon.py): a pump consults the site
+                          the first time it finds work to serve after
+                          launch.  ``kind="wedge"`` parks the pump with
+                          its heartbeat frozen (the external-watchdog →
+                          failover path); any other kind raises in the
+                          pump loop — an engine-wide fault the daemon
+                          fails over.
 ========================  ====================================================
 
 Every hook is guarded by ``if <owner>._chaos is not None`` at the call
@@ -70,12 +78,23 @@ ever, and never again after recovery replays the surrounding work.
 Probabilistic firing is a pure function of (plan seed, site, spec index,
 event index) — no hidden RNG state, so interleaving across sites cannot
 perturb the schedule.
+
+Concurrency (the daemonized tier — serving/daemon.py): each site owns its
+OWN lock, taken for exactly the increment-and-match of one event.  Two
+threads consulting the SAME site serialize on that site's counter (no torn
+increments, no skipped or doubled indices); threads at DIFFERENT sites
+never contend — per-site order, not a global clock, which is what keeps a
+plan replayable: a site whose events are produced by one logical order
+(one engine's admissions, one dispatcher's dispatch attempts) assigns the
+same index to the same logical operation regardless of how the OTHER
+sites' threads interleave around it.
 """
 
 from __future__ import annotations
 
 import hashlib
 import struct
+import threading
 from dataclasses import dataclass, field
 
 SITES = (
@@ -88,6 +107,7 @@ SITES = (
     "serving-callback",
     "router-dispatch",
     "weight-swap",
+    "daemon-pump",
 )
 
 
@@ -181,6 +201,12 @@ class FaultInjector:
         for idx, spec in enumerate(plan.faults):
             self._by_site[spec.site].append((idx, spec))
         self._events: dict[str, int] = {s: 0 for s in SITES}
+        # one lock PER SITE (module docstring §Concurrency): an event's
+        # increment-and-match is atomic against other threads at the same
+        # site, and sites never contend with each other.  A spec belongs
+        # to exactly one site, so _spec_fires entries are only ever
+        # touched under that spec's site lock.
+        self._locks: dict[str, threading.Lock] = {s: threading.Lock() for s in SITES}
         self._spec_fires: dict[int, int] = {}
         self.fired: list[_Fired] = []
 
@@ -193,24 +219,34 @@ class FaultInjector:
 
         The first matching spec (plan order) wins the event; explicit
         ``at`` indices are checked before the seeded coin so a plan can mix
-        pinned and probabilistic faults at one site.
+        pinned and probabilistic faults at one site.  Thread-safe: the
+        event index and its match verdict are assigned under the site's
+        lock, so concurrent consultations of one site serialize into a
+        gap-free per-site order.
         """
+        return self.fire_event(site)[1]
+
+    def fire_event(self, site: str) -> tuple[int, FaultSpec | None]:
+        """:meth:`fire`, also returning THIS consultation's event index —
+        the concurrency-safe form (re-reading the counter after the fact
+        would observe other threads' events)."""
         if site not in self._by_site:
             raise ValueError(f"unknown chaos site {site!r}; known: {SITES}")
-        event = self._events[site]
-        self._events[site] = event + 1
-        for idx, spec in self._by_site[site]:
-            if spec.max_fires is not None and self._spec_fires.get(idx, 0) >= spec.max_fires:
-                continue
-            hit = event in spec.at or (
-                spec.prob > 0.0
-                and _hash_uniform(self.plan.seed, site, idx, event) < spec.prob
-            )
-            if hit:
-                self._spec_fires[idx] = self._spec_fires.get(idx, 0) + 1
-                self.fired.append(_Fired(site=site, event=event, kind=spec.kind, spec_idx=idx))
-                return spec
-        return None
+        with self._locks[site]:
+            event = self._events[site]
+            self._events[site] = event + 1
+            for idx, spec in self._by_site[site]:
+                if spec.max_fires is not None and self._spec_fires.get(idx, 0) >= spec.max_fires:
+                    continue
+                hit = event in spec.at or (
+                    spec.prob > 0.0
+                    and _hash_uniform(self.plan.seed, site, idx, event) < spec.prob
+                )
+                if hit:
+                    self._spec_fires[idx] = self._spec_fires.get(idx, 0) + 1
+                    self.fired.append(_Fired(site=site, event=event, kind=spec.kind, spec_idx=idx))
+                    return event, spec
+        return event, None
 
     def raise_if_fired(self, site: str, exc: type[Exception] = ChaosFault) -> None:
         """Convenience for raise-only sites: fire, and raise on a hit.
@@ -218,10 +254,9 @@ class FaultInjector:
         ``exc`` is instantiated as ``exc(site, kind, event)`` when it is
         :class:`ChaosFault`, else ``exc(message)`` (e.g. ``OSError``).
         """
-        spec = self.fire(site)
+        event, spec = self.fire_event(site)
         if spec is None:
             return
-        event = self._events[site] - 1
         if exc is ChaosFault:
             raise ChaosFault(site, spec.kind, event)
         raise exc(f"chaos: injected {spec.kind!r} fault at site {site!r} event {event}")

@@ -48,6 +48,7 @@ import json
 import math
 import os
 import re
+import threading
 import time
 from collections import deque
 from typing import Callable
@@ -268,57 +269,71 @@ class MetricsRegistry:
     gauges keep the MAX across sources — a gauge is a point-in-time
     reading, so the honest cluster rollup is "worst observed", with
     per-source values preserved in the sampler's JSONL time-series.
+
+    Thread-safe (the daemonized tier calls ``inc``/``observe`` from N
+    pump threads into ONE shared registry): every mutator and snapshot
+    holds one internal lock, so ``counters[k] = counters.get(k) + n``
+    can never lose an increment between threads and a snapshot never
+    reads a histogram mid-rotate.
     """
 
     def __init__(self, *, window: int = 8, lo: float = 1e-6,
                  hi: float = 1e4, growth: float = 1.1):
         self._window = int(window)
         self._sketch_kw = {"lo": lo, "hi": hi, "growth": growth}
+        self._lock = threading.RLock()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
         self.histograms: dict[str, RollingHistogram] = {}
 
     def inc(self, name: str, n: float = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + n
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     def set_gauge(self, name: str, value) -> None:
-        self.gauges[name] = value
+        with self._lock:
+            self.gauges[name] = value
 
     def observe(self, name: str, value: float) -> None:
-        h = self.histograms.get(name)
-        if h is None:
-            h = self.histograms[name] = RollingHistogram(
-                window=self._window, **self._sketch_kw)
-        h.record(value)
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = RollingHistogram(
+                    window=self._window, **self._sketch_kw)
+            h.record(value)
 
     def rotate(self) -> None:
-        for h in self.histograms.values():
-            h.rotate()
+        with self._lock:
+            for h in self.histograms.values():
+                h.rotate()
 
     def snapshot(self) -> dict:
         """One sample's registry view: lifetime count/sum/min/max +
         lifetime and rolling-window percentiles per histogram."""
-        hists = {}
-        for name, h in self.histograms.items():
-            lt, w = h.lifetime, h.window_sketch()
-            d = {"count": lt.count, "sum": round(lt.sum, 6),
-                 "min": lt.min, "max": lt.max}
-            d.update(lt.percentiles())
-            d["window_count"] = w.count
-            d.update({f"window_{k}": v for k, v in w.percentiles().items()})
-            hists[name] = d
-        return _sanitize({"counters": dict(self.counters),
-                          "gauges": dict(self.gauges),
-                          "histograms": hists})
+        with self._lock:
+            hists = {}
+            for name, h in self.histograms.items():
+                lt, w = h.lifetime, h.window_sketch()
+                d = {"count": lt.count, "sum": round(lt.sum, 6),
+                     "min": lt.min, "max": lt.max}
+                d.update(lt.percentiles())
+                d["window_count"] = w.count
+                d.update({f"window_{k}": v
+                          for k, v in w.percentiles().items()})
+                hists[name] = d
+            return _sanitize({"counters": dict(self.counters),
+                              "gauges": dict(self.gauges),
+                              "histograms": hists})
 
     def to_dict(self) -> dict:
         """Mergeable strict-JSON dump (full sketches, not percentiles)."""
-        return _sanitize({
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "histograms": {n: h.lifetime.to_dict()
-                           for n, h in self.histograms.items()},
-        })
+        with self._lock:
+            return _sanitize({
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {n: h.lifetime.to_dict()
+                               for n, h in self.histograms.items()},
+            })
 
     @classmethod
     def merge(cls, dumps: list[dict]) -> dict:
@@ -352,6 +367,10 @@ class MetricsRegistry:
         overflow into ``+Inf`` only; ``le`` is each log-bucket's upper
         bound).  ``extra_gauges`` lets the sampler export source vitals
         without registering them as registry gauges."""
+        with self._lock:
+            return self._to_prometheus_locked(prefix, extra_gauges)
+
+    def _to_prometheus_locked(self, prefix, extra_gauges):
         lines: list[str] = []
         for name in sorted(self.counters):
             m = f"{prefix}_{_prom_name(name)}"
@@ -396,6 +415,14 @@ class Telemetry:
     context manager).  The JSONL file is opened in APPEND mode, so a
     crashed run's partial time-series survives and a restarted run
     continues the same file.
+
+    Thread-safe: the daemonized tier calls ``maybe_sample()`` from every
+    pump thread against one shared sampler.  The interval pre-check is a
+    lock-free fast path (a stale read at worst defers one sample by one
+    call); the sample itself — sources, JSONL append, Prometheus rewrite,
+    window rotate — runs under an RLock (reentrant because ``close()``
+    takes a final sample) with the due-check repeated inside, so two
+    threads arriving at the same tick produce ONE record, not two.
     """
 
     def __init__(self, *, interval_s: float = 1.0,
@@ -420,6 +447,7 @@ class Telemetry:
         self.samples = 0
         self.source_errors = 0
         self._closed = False
+        self._sample_lock = threading.RLock()
 
     # --- wiring -----------------------------------------------------
     def register_source(self, name: str, fn: Callable[[], dict]) -> None:
@@ -456,34 +484,44 @@ class Telemetry:
             return None
         now = self.clock() if now is None else now
         if self._last_t is not None and (now - self._last_t) < self.interval_s:
-            return None
-        return self.sample(now)
+            return None  # lock-free fast path: not due (stale read is benign)
+        with self._sample_lock:
+            if self._closed:
+                return None
+            # re-check under the lock: another thread may have sampled
+            # between our pre-check and our acquisition
+            if (self._last_t is not None
+                    and (now - self._last_t) < self.interval_s):
+                return None
+            return self.sample(now)
 
     def sample(self, now: float | None = None) -> dict:
         """Force one sample: collect every source's vitals, snapshot the
         registry, append one strict-JSON line, rewrite the Prometheus
         file, rotate the rolling-histogram windows."""
-        if self._closed:
-            raise RuntimeError("Telemetry is closed — no further samples")
-        now = self.clock() if now is None else now
-        self._last_t = now
-        sources: dict[str, dict] = {}
-        for name, fn in list(self._sources.items()):
-            try:
-                sources[name] = fn()
-            except Exception as e:  # a sick source must not kill the loop
-                self.source_errors += 1
-                sources[name] = {"error": f"{type(e).__name__}: {e}"}
-        record = _sanitize({"t": round(now, 6), "sample": self.samples,
-                            "sources": sources, **self.registry.snapshot()})
-        self.samples += 1
-        if self._file is not None:
-            self._file.write(json.dumps(record, allow_nan=False) + "\n")
-            self._file.flush()
-        if self.prom_path is not None:
-            self._write_prom(record)
-        self.registry.rotate()
-        return record
+        with self._sample_lock:
+            if self._closed:
+                raise RuntimeError("Telemetry is closed — no further samples")
+            now = self.clock() if now is None else now
+            self._last_t = now
+            sources: dict[str, dict] = {}
+            for name, fn in list(self._sources.items()):
+                try:
+                    sources[name] = fn()
+                except Exception as e:  # a sick source must not kill the loop
+                    self.source_errors += 1
+                    sources[name] = {"error": f"{type(e).__name__}: {e}"}
+            record = _sanitize({"t": round(now, 6), "sample": self.samples,
+                                "sources": sources,
+                                **self.registry.snapshot()})
+            self.samples += 1
+            if self._file is not None:
+                self._file.write(json.dumps(record, allow_nan=False) + "\n")
+                self._file.flush()
+            if self.prom_path is not None:
+                self._write_prom(record)
+            self.registry.rotate()
+            return record
 
     def _write_prom(self, record: dict) -> None:
         extra: dict[str, float] = {}
@@ -497,15 +535,16 @@ class Telemetry:
 
     def close(self) -> None:
         """Final sample + file close; idempotent."""
-        if self._closed:
-            return
-        try:
-            self.sample()
-        finally:
-            self._closed = True
-            if self._file is not None:
-                self._file.close()
-                self._file = None
+        with self._sample_lock:
+            if self._closed:
+                return
+            try:
+                self.sample()
+            finally:
+                self._closed = True
+                if self._file is not None:
+                    self._file.close()
+                    self._file = None
 
     def __enter__(self) -> "Telemetry":
         return self

@@ -199,6 +199,30 @@ def test_failed_probe_fails_replica_and_own_faults_stay_failed():
     r.close()
 
 
+def test_raising_probe_isolates_to_failover_without_starving_siblings():
+    """A probe (or any per-replica pump error) that RAISES inside
+    Router.step must convert to that replica's failover, not propagate —
+    and the sibling must still be pumped in the SAME iteration, so one
+    sick replica can never starve the tier (ISSUE 15 regression)."""
+    model, params = _model_and_params()
+    want = _reference(model, params)
+
+    def probe(rep):
+        if rep.index == 0:
+            raise RuntimeError("probe exploded")
+        return True
+
+    r = Router(_factory(model, params), 2, probe=probe)
+    rrs = [r.submit(p, max_new=6) for p in PROMPTS]
+    produced = r.step()        # raising probe must not escape step()
+    assert r.replicas[0].state == "failed" and r.failovers == 1
+    assert produced > 0        # replica 1 was pumped the same iteration
+    r.run_until_done()
+    assert all(rr.status == "done" for rr in rrs)    # zero drops
+    assert [list(rr.generated) for rr in rrs] == want
+    r.close()
+
+
 def test_restart_respawns_failed_replica_fresh():
     model, params = _model_and_params()
     r = Router(_factory(model, params), 2)
