@@ -51,11 +51,18 @@ daemonized tier under an OPEN-loop Poisson generator — goodput under
 overload with deadline shedding, a chaos pump-kill leg gating the
 failover goodput floor / zero drops / exactly-once streams, and the
 drain-clean lifecycle — scripts/bench_slo.py, skip with
-DTM_BENCH_SKIP_SLO_DAEMON).  The tp_serving, train_census, quant,
-sampling, slo_daemon, and serving-subprocess gates (compile census
-budgets, the ISSUE 11 telemetry <=2% overhead bar, SLO/goodput counter
-arithmetic) fail the bench run (exit 3) on breach, after the record
-prints.
+DTM_BENCH_SKIP_SLO_DAEMON), and a ``disagg`` block (ISSUE 16: the
+role-typed prefill/decode tier — short-request TTFT p99 held within
+1.15x of the unloaded control (in router steps) while a long-prompt
+stream saturates the prefill replica, token parity vs the monolithic
+tier on the full mixed stream, a kv-handoff chaos leg gating
+exactly-once streams, and the per-role compile census (decode replicas
+compile zero prefill programs and vice versa) —
+scripts/bench_disagg.py, skip with DTM_BENCH_SKIP_DISAGG).  The
+tp_serving, train_census, quant, sampling, slo_daemon, disagg, and
+serving-subprocess gates (compile census budgets, the ISSUE 11
+telemetry <=2% overhead bar, SLO/goodput counter arithmetic) fail the
+bench run (exit 3) on breach, after the record prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -792,6 +799,50 @@ def main() -> None:
             slo_gate_rc = 1
             print(f"bench: slo_daemon phase failed: {e!r}", file=sys.stderr)
 
+    # role-typed prefill/decode tier (ISSUE 16): a deterministic drip
+    # driver gates short-request TTFT flatness (router steps) under a
+    # saturating long-prompt stream, token parity vs the monolithic
+    # tier, kv-handoff chaos exactly-once, and the per-role compile
+    # census (decode replicas compile zero prefill programs and vice
+    # versa).  A breach FAILS the bench run (exit 3) after the record
+    # prints.  Runs scripts/bench_disagg.py in a SUBPROCESS on the CPU
+    # backend.  Skippable (DTM_BENCH_SKIP_DISAGG).
+    disagg = None
+    disagg_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_DISAGG"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_disagg.py")],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "disagg":
+                    disagg = rec
+            if disagg is None or out.returncode != 0:
+                disagg_gate_rc = out.returncode or 1
+                print(
+                    f"bench: disagg subprocess "
+                    f"{'produced no record' if disagg is None else 'FAILED (TTFT/parity/chaos/census gate breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            disagg_gate_rc = 1
+            print(f"bench: disagg phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -903,6 +954,10 @@ def main() -> None:
         result["slo_daemon"] = {
             k: v for k, v in slo_daemon.items() if k != "metric"
         }
+    if disagg is not None:
+        result["disagg"] = {
+            k: v for k, v in disagg.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -916,7 +971,8 @@ def main() -> None:
     # arithmetic) fail the RUN, not just their block — after the record
     # prints so the numbers are never lost with the verdict
     if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
-            or sampling_gate_rc or chunked_gate_rc or slo_gate_rc):
+            or sampling_gate_rc or chunked_gate_rc or slo_gate_rc
+            or disagg_gate_rc):
         import sys
 
         sys.exit(3)

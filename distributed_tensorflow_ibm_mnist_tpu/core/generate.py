@@ -505,25 +505,46 @@ def _filter_topk_rows(logits, top_ks):
     return jnp.where(on[:, None], filtered, logits)
 
 
-def _tempered_rows(logits, temps, topps, topks):
+def _filter_minp_rows(logits, min_ps):
+    """Per-row min-p filter with ``min_p`` as DATA: tokens whose
+    probability is below ``min_p * max_prob`` are cut, so the threshold
+    scales with the model's confidence (a peaked distribution prunes
+    aggressively, a flat one keeps its tail).  ``min_ps`` is (B,)
+    float32; rows with ``min_p <= 0`` pass through unfiltered, so greedy
+    and min-p-free rows ride the same program.  The argmax always
+    survives (its prob equals max_prob and ``min_p <= 1``), so
+    ``min_p = 1.0`` reduces to greedy."""
+    neg = jnp.finfo(logits.dtype).min
+    probs = jax.nn.softmax(logits, axis=-1)
+    cutoff = min_ps[:, None] * jnp.max(probs, axis=-1, keepdims=True)
+    filtered = jnp.where(probs < cutoff, neg, logits)
+    on = min_ps > 0.0
+    return jnp.where(on[:, None], filtered, logits)
+
+
+def _tempered_rows(logits, temps, topps, topks, minps):
     """The per-row SAMPLING distribution as filtered logits: temperature
     scaling (before the filters, matching :func:`make_generator`'s static
-    order), then the data-driven top-k and nucleus filters (top-k first,
-    like the static path).  Rows with ``temps <= 0`` get a well-defined
-    placeholder (divide by 1) — their output is overridden by argmax in
-    :func:`_pick_rows`, the placeholder just keeps the math NaN-free."""
+    order), then the data-driven top-k, nucleus, and min-p filters (top-k
+    first, like the static path; min-p last so its confidence-relative
+    cut applies to the already-truncated support).  Rows with
+    ``temps <= 0`` get a well-defined placeholder (divide by 1) — their
+    output is overridden by argmax in :func:`_pick_rows`, the placeholder
+    just keeps the math NaN-free."""
     safe_t = jnp.where(temps > 0.0, temps, 1.0)[:, None]
     scaled = logits / safe_t
     scaled = _filter_topk_rows(scaled, jnp.asarray(topks, jnp.int32))
-    return _filter_topp_rows(scaled, topps)
+    scaled = _filter_topp_rows(scaled, topps)
+    return _filter_minp_rows(scaled, jnp.asarray(minps, jnp.float32))
 
 
-def _pick_rows(logits, temps, topps, topks, keys):
+def _pick_rows(logits, temps, topps, topks, minps, keys):
     """Data-driven per-row pick: (B, V) logits + per-row ``temps`` /
-    ``topps`` / ``topks`` / already-fold-in'd ``keys`` (B, 2) uint32
-    planes -> ``((B,) int32 token, (B,) float32 logprob)``.  Rows with
-    ``temps <= 0`` take argmax (greedy) — selected by ``where`` on the
-    DATA, so every (temperature, top_p, top_k) mix shares one program.
+    ``topps`` / ``topks`` / ``minps`` / already-fold-in'd ``keys`` (B, 2)
+    uint32 planes -> ``((B,) int32 token, (B,) float32 logprob)``.  Rows
+    with ``temps <= 0`` take argmax (greedy) — selected by ``where`` on
+    the DATA, so every (temperature, top_p, top_k, min_p) mix shares one
+    program.
 
     The logprob is always ``log_softmax`` of the RAW logits at the
     emitted token — the model's own distribution, before temperature or
@@ -531,7 +552,7 @@ def _pick_rows(logits, temps, topps, topks, keys):
     sampling configs and greedy requests report calibrated confidences.
     """
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    filtered = _tempered_rows(logits, temps, topps, topks)
+    filtered = _tempered_rows(logits, temps, topps, topks, minps)
     sampled = jax.vmap(
         lambda l, k: jax.random.categorical(k, l))(filtered, keys)
     tok = jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
@@ -541,14 +562,14 @@ def _pick_rows(logits, temps, topps, topks, keys):
 
 
 def _sample_window_core(model, params, cache, tok, active, temps, topps,
-                        topks, keys, pos, window: int, max_len: int,
+                        topks, minps, keys, pos, window: int, max_len: int,
                         ragged: bool, pad_id: int):
     """The sampling-aware decode-ahead window (ISSUE 13): ``window`` fused
     decode+pick steps as ONE ``lax.scan``, with the per-row sampling
     planes as runtime DATA and the PRNG threaded through the carry.
 
-    ``temps``/``topps`` are (B,) float32, ``topks`` (B,) int32, ``keys``
-    (B, 2) uint32 BASE keys
+    ``temps``/``topps``/``minps`` are (B,) float32, ``topks`` (B,) int32,
+    ``keys`` (B, 2) uint32 BASE keys
     (one per request, a pure function of its seed), ``pos`` (B,) int32 the
     per-row count of already-generated tokens.  The token at generated
     index ``n`` is picked with ``fold_in(base_key, n)``, and ``pos``
@@ -563,6 +584,7 @@ def _sample_window_core(model, params, cache, tok, active, temps, topps,
     temps = jnp.asarray(temps, jnp.float32)
     topps = jnp.asarray(topps, jnp.float32)
     topks = jnp.asarray(topks, jnp.int32)
+    minps = jnp.asarray(minps, jnp.float32)
     keys = jnp.asarray(keys, jnp.uint32)
     step = active.astype(jnp.int32)
 
@@ -571,7 +593,8 @@ def _sample_window_core(model, params, cache, tok, active, temps, topps,
         cache, logits = _decode_step_core(model, params, cache, tok,
                                           max_len, ragged)
         step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
-        nxt, logp = _pick_rows(logits, temps, topps, topks, step_keys)
+        nxt, logp = _pick_rows(logits, temps, topps, topks, minps,
+                               step_keys)
         nxt = jnp.where(active, nxt, pad)
         logp = jnp.where(active, logp, 0.0)
         return (cache, nxt, pos + step), (nxt, logp)
@@ -583,8 +606,8 @@ def _sample_window_core(model, params, cache, tok, active, temps, topps,
 
 
 def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
-                        temps, topps, topks, keys, pos, max_len: int,
-                        pad_id: int):
+                        temps, topps, topks, minps, keys, pos,
+                        max_len: int, pad_id: int):
     """Speculative verify with REJECTION SAMPLING (ISSUE 13) — the
     sampling-aware sibling of :func:`_verify_window_core`, sharing its
     one-forward / cursor-rewind mechanics and its (B, k) chunk contract.
@@ -621,6 +644,7 @@ def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
     temps = jnp.asarray(temps, jnp.float32)
     topps = jnp.asarray(topps, jnp.float32)
     topks = jnp.asarray(topks, jnp.int32)
+    minps = jnp.asarray(minps, jnp.float32)
     keys = jnp.asarray(keys, jnp.uint32)
     pos = jnp.asarray(pos, jnp.int32)
     idx0 = _cache_cursor(cache)
@@ -639,7 +663,8 @@ def _verify_sample_core(model, params, cache, chunk, draft_lens, active,
     flat = logits.reshape(b * k, -1)
     filt = _tempered_rows(flat, jnp.repeat(temps, k),
                           jnp.repeat(topps, k),
-                          jnp.repeat(topks, k)).reshape(b, k, -1)
+                          jnp.repeat(topks, k),
+                          jnp.repeat(minps, k)).reshape(b, k, -1)
     probs = jax.nn.softmax(filt, axis=-1)                        # (B, k, V)
 
     # generated index per position and its key family (flattened B*k)

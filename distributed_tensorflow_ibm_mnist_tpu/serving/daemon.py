@@ -44,8 +44,18 @@ Thread topology (N replicas → N+3 threads)::
   The watchdog reads :attr:`InferenceEngine.heartbeat_t` from outside:
   a HEALTHY replica with work whose heartbeat stays frozen past
   ``liveness_timeout_s`` is declared wedged and failed over.  It also
-  retries router orphans, scans for completions when no pump is alive
-  to, and ticks ``telemetry.maybe_sample()``.
+  pumps prefill→decode handoffs on disaggregated tiers (below), retries
+  router orphans, scans for completions when no pump is alive to, and
+  ticks ``telemetry.maybe_sample()``.
+
+Disaggregation (ISSUE 16): on a role-typed tier the watchdog drains the
+prefill replicas' outboxes each tick (``Router._pump_handoffs`` under the
+tier lock).  Landing a packet mutates the DESTINATION engine, whose pump
+thread may be mid-``step()`` — so each replica's engine carries a daemon
+lock: pumps hold their replica's lock around ``step()``, and the handoff
+pump holds the destination's around ``admit_prefilled`` (installed via
+``Router._admit_guard``).  Outbox appends/pops themselves are CPython
+atomic deque ops, so the SOURCE side needs no lock beyond the tier's.
 
 Locking: ONE tier lock serializes every router-level mutation (dispatch,
 failover harvest, orphan retry, close) — the router itself stays
@@ -204,6 +214,13 @@ class ServingDaemon:
 
         # the ONE lock for router-level mutations (module docstring)
         self._tier_lock = threading.RLock()
+        # per-replica ENGINE locks (module docstring §Disaggregation):
+        # a replica's pump holds its own around step(); the watchdog's
+        # handoff pump holds the destination's around admit_prefilled.
+        # Keyed by index — stable across respawns.
+        self._engine_locks = {rep.index: threading.Lock()
+                              for rep in router.replicas}
+        router._admit_guard = lambda rep: self._engine_locks[rep.index]
         # admission: policy-ordered heap + its own condition variable
         self._adm_cv = threading.Condition()
         self._admission: list[tuple[tuple, DaemonRequest]] = []
@@ -412,7 +429,8 @@ class ServingDaemon:
                         rep, ChaosFault("daemon-pump", spec.kind, event))
                     return
             try:
-                rep.engine.step()
+                with self._engine_locks[rep.index]:
+                    rep.engine.step()
             except Exception as e:
                 self._fail_from_pump(rep, e)
                 return
@@ -570,6 +588,10 @@ class ServingDaemon:
         while not self._stop.is_set():
             self._scan_completions()
             with self._tier_lock:
+                try:
+                    self.router._pump_handoffs()
+                except Exception:
+                    pass   # a sick handoff pump must not kill the watchdog
                 if self.router._orphans:
                     try:
                         self.router._retry_orphans()

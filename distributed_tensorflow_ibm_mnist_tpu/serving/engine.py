@@ -203,13 +203,18 @@ from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
 from distributed_tensorflow_ibm_mnist_tpu.serving.drafter import NgramDrafter
 from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
     KVPagePool,
+    bt_install,
+    gather_page,
     make_paged_extend,
     make_paged_insert,
+    page_write,
     paged_cache_shapes,
     paged_reset,
     pages_needed,
     pool_page_bytes,
+    pool_page_leaves,
 )
+from distributed_tensorflow_ibm_mnist_tpu.serving import kv_handoff
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import PrefixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.radix_cache import RadixCache
 from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import (
@@ -232,6 +237,12 @@ _RADIX_PREFILL = object()
 # retry re-runs _chunk_admit from the allocation, skipping the already-
 # fired serving-admit chaos event (one event per admission attempt)
 _CHUNK_STALL = object()
+
+# sentinel "first token" _paged_land returns on a prefill-role engine
+# (ISSUE 16): no token was picked — the landing was packaged into the
+# handoff outbox and the slot is already free (its pages moved to the
+# packet's hold; the block table gets the caller's reset)
+_HANDOFF = object()
 
 
 class EngineStalled(RuntimeError):
@@ -335,6 +346,7 @@ class InferenceEngine:
                  quant: str | None = None,
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 min_p: float = 0.0, role: str = "both",
                  rng=None, writer: MetricWriter | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  stall_timeout_s: float | None = None,
@@ -373,9 +385,22 @@ class InferenceEngine:
             raise ValueError(
                 f"eos_id and pad_id must differ (both {eos_id}): idle slots "
                 "are fed pad_id, which must never read as a stop")
-        if temperature == 0.0 and (top_k or top_p):
+        if temperature == 0.0 and (top_k or top_p or min_p):
             raise ValueError(
-                "top_k/top_p filter a SAMPLING distribution; set temperature > 0")
+                "top_k/top_p/min_p filter a SAMPLING distribution; set "
+                "temperature > 0")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill', or 'decode', got {role!r}")
+        if role != "both" and not kv_page_size:
+            raise ValueError(
+                "disaggregated roles hand KV off as PAGES — role="
+                f"{role!r} needs the paged cache (kv_page_size > 0)")
+        if role != "both" and speculative is not None:
+            raise ValueError(
+                "speculative decoding does not compose with disaggregated "
+                "roles yet — the verify family would have to compile on "
+                "both sides, voiding the per-role census")
         if temperature != 0.0 and rng is None:
             raise ValueError(
                 "temperature > 0 samples from the model — pass rng=")
@@ -582,7 +607,20 @@ class InferenceEngine:
                 "cache cannot hold")
         self.buckets = self.scheduler.buckets
         self.writer = writer
-        self.stats = ServingStats(slots, decode_ahead=self.decode_ahead)
+        # disaggregated serving (ISSUE 16): "both" is the monolithic
+        # engine, byte-identical to every prior PR.  "prefill" runs the
+        # prefill/extend program family only and diverts finished
+        # landings to a handoff outbox (serving/kv_handoff.py) instead of
+        # decoding; "decode" accepts handed-off pages via
+        # admit_prefilled() and never compiles a prefill bucket.
+        self.role = role
+        self.stats = ServingStats(slots, decode_ahead=self.decode_ahead,
+                                  role=role)
+        # prefill-role outbox: HandoffPacket per finished prefill, drained
+        # by the router's handoff pump (or the owner directly in tests)
+        self._outbox: deque = deque()
+        self.handoffs_out = 0   # packets packaged (prefill side)
+        self.handoffs_in = 0    # packets landed (decode side)
 
         # --- compiled device programs (all resident, all fixed-shape) ---
         # The engine's slot cache is DONATED through every program that
@@ -649,18 +687,18 @@ class InferenceEngine:
         window_ = self.decode_ahead
 
         def _window_impl(params, cache, tok, active, temps, topps, topks,
-                         keys, pos):
+                         minps, keys, pos):
             # decode_ahead fused decode+pick steps as ONE dispatch
             # (core/generate.py _sample_window_core): the host loop pays
             # per-iteration dispatch latency and ONE blocking readback per
-            # WINDOW instead of per token.  temperature/top_p/top_k/base-
-            # key/position ride as per-slot DATA planes, so every sampling
-            # mix (greedy included) is this ONE program — the census never
-            # moves across distinct (temperature, top_p, top_k, seed)
-            # configs.
+            # WINDOW instead of per token.  temperature/top_p/top_k/min_p/
+            # base-key/position ride as per-slot DATA planes, so every
+            # sampling mix (greedy included) is this ONE program — the
+            # census never moves across distinct (temperature, top_p,
+            # top_k, min_p, seed) configs.
             cache, blk, logps, last, pos = _sample_window_core(
                 decode_model, params, cache, tok, active, temps, topps,
-                topks, keys, pos, window_, max_len, True, pad_id_)
+                topks, minps, keys, pos, window_, max_len, True, pad_id_)
             return _pin(cache), blk, logps, last, pos
 
         self._window = jax.jit(_window_impl, donate_argnums=(1,))
@@ -676,10 +714,10 @@ class InferenceEngine:
             # happens on the host between windows, which a fused k-step
             # scan could never pause for.
             def _verify_impl(params, cache, chunk, draft_lens, active,
-                             temps, topps, topks, keys, pos):
+                             temps, topps, topks, minps, keys, pos):
                 cache, *rest = _verify_sample_core(
                     decode_model, params, cache, chunk, draft_lens, active,
-                    temps, topps, topks, keys, pos, max_len, pad_id_)
+                    temps, topps, topks, minps, keys, pos, max_len, pad_id_)
                 return (_pin(cache), *rest)
 
             self._verify = jax.jit(_verify_impl, donate_argnums=(1,))
@@ -703,6 +741,21 @@ class InferenceEngine:
 
             self._extend = jax.jit(_extend_row, donate_argnums=(1,))
 
+            # disaggregated handoff programs (serving/kv_handoff.py): one
+            # fixed-shape page gather (read-only — the source pool stays
+            # live until the transfer commits) and the destination-side
+            # per-page scatter + no-forward block-table install, both with
+            # the cache donated like every other cache-threading program
+            self._page_gather = jax.jit(gather_page)
+            self._page_write = jax.jit(
+                lambda cache, payload, pid: _pin(
+                    page_write(cache, payload, pid)),
+                donate_argnums=(0,))
+            self._bt_install = jax.jit(
+                lambda cache, bt_row, slot, cur: _pin(
+                    bt_install(cache, bt_row, slot, cur)),
+                donate_argnums=(0,))
+
         def _prefill_row(params, prompt, lens):
             # the B=1 row cache is pinned head-sharded too: the insert
             # program's row input then always arrives in ONE layout,
@@ -721,6 +774,7 @@ class InferenceEngine:
         self._default_temp = float(temperature)
         self._default_topp = float(top_p)
         self._top_k = top_k_
+        self._default_minp = float(min_p)
         if rng is None:
             self._default_key = base_key(0)
         else:
@@ -780,8 +834,9 @@ class InferenceEngine:
         self._slot_temp = np.full((slots,), self._default_temp, np.float32)
         self._slot_topp = np.full((slots,), self._default_topp, np.float32)
         self._slot_topk = np.full((slots,), self._top_k, np.int32)
+        self._slot_minp = np.full((slots,), self._default_minp, np.float32)
         self._slot_key = np.tile(self._default_key, (slots, 1))
-        # (temps, topps, topks, keys) on device; None = stale
+        # (temps, topps, topks, minps, keys) on device; None = stale
         self._planes_dev = None
         # device (slots,) int32 count of already-generated tokens per slot
         # — the PRNG position plane.  Plain windows return the advanced
@@ -936,6 +991,11 @@ class InferenceEngine:
             raise RuntimeError(
                 "engine is " + ("closed" if self._closed else "draining")
                 + " — no new requests")
+        if self.role == "decode":
+            raise RuntimeError(
+                "decode-role engine takes no direct submissions — its work "
+                "arrives prefilled via admit_prefilled (route admissions "
+                "to a prefill/both replica; serving/router.py does)")
         return self.scheduler.submit(prompt, max_new, deadline_s=deadline_s,
                                      callback=callback,
                                      ttft_slo_s=ttft_slo_s,
@@ -970,15 +1030,15 @@ class InferenceEngine:
         return self._last_progress_ever
 
     def _req_sampling(self, req: Request):
-        """``(temperature, top_p, top_k, base_key)`` resolved for ``req``
-        — its own :class:`SamplingParams`, or the engine's
+        """``(temperature, top_p, top_k, min_p, base_key)`` resolved for
+        ``req`` — its own :class:`SamplingParams`, or the engine's
         construction-time defaults for requests submitted without one."""
         s = req.sampling
         if s is None:
             return (self._default_temp, self._default_topp, self._top_k,
-                    self._default_key)
+                    self._default_minp, self._default_key)
         return (float(s.temperature), float(s.top_p), int(s.top_k),
-                s.key())
+                float(s.min_p), s.key())
 
     def _first_pick(self, req: Request, logits):
         """Pick ``req``'s FIRST token (generated index 0) from the
@@ -987,12 +1047,13 @@ class InferenceEngine:
         program for a fresh prefill, a prefix-cache hit, and a paged
         radix-extend landing, so hit/miss first tokens are bit-identical.
         Returns ``(token, logprob)`` as host scalars."""
-        temp, topp, topk, key = self._req_sampling(req)
+        temp, topp, topk, minp, key = self._req_sampling(req)
         with self._compile.site("first_pick"):
             tok, logp = first_pick(
                 logits, self._dev(np.array([temp], np.float32)),
                 self._dev(np.array([topp], np.float32)),
                 self._dev(np.array([topk], np.int32)),
+                self._dev(np.array([minp], np.float32)),
                 self._dev(key[None, :].astype(np.uint32)),
                 self._dev(np.zeros((1,), np.int32)))
         return int(tok[0]), float(logp[0])
@@ -1236,7 +1297,13 @@ class InferenceEngine:
                     bt_dev, jnp.asarray(padded),
                     jnp.asarray(m_tok, jnp.int32),
                     jnp.asarray(suffix.size, jnp.int32))
-            first, first_logp = self._first_pick(req, ext_logits)
+            if self.role == "prefill":
+                # disaggregated (ISSUE 16): stop where the pick would
+                # run — the logits row travels in the packet and the
+                # DECODE side picks through the same shared program
+                first, first_logp, land_logits = _HANDOFF, None, ext_logits
+            else:
+                first, first_logp = self._first_pick(req, ext_logits)
             self.stats.radix(True, tokens=m_tok)
             self._radix.record(True, tokens=m_tok)
             req.radix_tokens = m_tok
@@ -1245,7 +1312,10 @@ class InferenceEngine:
             with self._compile.site("slot_insert"):
                 self.cache = self._insert(self.cache, row_cache, bt_dev,
                                           jnp.asarray(slot, jnp.int32))
-            first, first_logp = self._first_pick(req, logits)
+            if self.role == "prefill":
+                first, first_logp, land_logits = _HANDOFF, None, logits
+            else:
+                first, first_logp = self._first_pick(req, logits)
             if self._radix is not None:
                 self.stats.radix(False)
                 self._radix.record(False)
@@ -1267,6 +1337,13 @@ class InferenceEngine:
                 for node in held:
                     priv.remove(node.page)
                     nodes.append(node)
+        if first is _HANDOFF:
+            # package AFTER the donation, so the source trie shares this
+            # prompt's blocks with later prefills (and with the re-prefill
+            # a dead transfer falls back to); exceptions propagate to
+            # _admit's failure path, which reclaims the still-slot-held
+            # allocation
+            self._handoff_package(req, slot, land_logits, bt_row)
         return first, first_logp, cache_hit
 
     def _admit(self, req: Request, slot: int, now: float,
@@ -1307,6 +1384,12 @@ class InferenceEngine:
                     return ("stall", prefilled)
                 first, first_logp, cache_hit = landed
                 inserted = True
+                if first is _HANDOFF:
+                    # prefill role: the landing went to the outbox, the
+                    # slot is free again (pages moved to the packet's
+                    # hold) — True asks the caller to reset the row's
+                    # block table unless a later admit overwrites it
+                    return True
             else:
                 row_cache, logits, cache_hit = prefilled
                 with self._compile.site("slot_insert"):
@@ -1353,10 +1436,11 @@ class InferenceEngine:
             return inserted
         self._slot_req[slot] = req
         self._slot_tok[slot] = first
-        temp, topp, topk, key = self._req_sampling(req)
+        temp, topp, topk, minp, key = self._req_sampling(req)
         self._slot_temp[slot] = temp
         self._slot_topp[slot] = topp
         self._slot_topk[slot] = topk
+        self._slot_minp[slot] = minp
         self._slot_key[slot] = key
         self._tok_dev = None  # host mirror changed; re-upload before decode
         self._active_dev = None
@@ -1531,6 +1615,39 @@ class InferenceEngine:
         phase).  Failure here is the request's own, exactly like the
         whole-prompt admission tail."""
         now = self.clock()
+        if self.role == "prefill":
+            # disaggregated (ISSUE 16): donate the freshly-chunked prompt
+            # blocks into the source trie, then package instead of
+            # picking — chunked prefill composes with handoff exactly as
+            # with local decode
+            try:
+                if self._radix is not None:
+                    n_tok = int(req.tokens.size)
+                    bt_row, m_blocks = rec["bt"], rec["m_blocks"]
+                    donate = {j: int(bt_row[j])
+                              for j in range(m_blocks,
+                                             n_tok // self._page_size)}
+                    if donate:
+                        priv, nodes = self._slot_alloc[slot]
+                        held, _kept = self._radix.insert(
+                            req.tokens, m_blocks, donate, rec["path"])
+                        for node in held:
+                            priv.remove(node.page)
+                            nodes.append(node)
+                self._handoff_package(req, slot, rec["last"], rec["bt"])
+            except Exception as e:
+                self._slot_req[slot] = None
+                self._slot_prefill[slot] = None
+                self._release_slot_alloc(slot)
+                self._active_dev = None
+                self._fail(req, e, self.clock())
+                reset_mask[slot] = True
+                return
+            self._slot_req[slot] = None
+            self._slot_prefill[slot] = None
+            self._active_dev = None
+            reset_mask[slot] = True
+            return
         try:
             first, first_logp = self._first_pick(req, rec["last"])
             if self._radix is not None:
@@ -1570,10 +1687,11 @@ class InferenceEngine:
             return
         self._slot_prefill[slot] = None
         self._slot_tok[slot] = first
-        temp, topp, topk, key = self._req_sampling(req)
+        temp, topp, topk, minp, key = self._req_sampling(req)
         self._slot_temp[slot] = temp
         self._slot_topp[slot] = topp
         self._slot_topk[slot] = topk
+        self._slot_minp[slot] = minp
         self._slot_key[slot] = key
         self._tok_dev = None  # host mirrors changed; re-upload
         self._active_dev = None
@@ -1670,6 +1788,48 @@ class InferenceEngine:
             self._pending.append((req, self._prefill_request(req)))
         except Exception as e:
             self._fail(req, e, self.clock())
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode handoff (ISSUE 16; serving/kv_handoff)
+
+    def _handoff_package(self, req: Request, slot: int, logits_dev,
+                         bt_row) -> None:
+        """Prefill role: gather the landed prompt's pages host-side and
+        park the request in the outbox (kv_handoff.package) — the slot's
+        page hold transfers to the packet, nothing frees until the router
+        confirms delivery."""
+        packet = kv_handoff.package(self, req, slot, logits_dev, bt_row)
+        self._outbox.append(packet)
+        self.handoffs_out += 1
+
+    def admit_prefilled(self, packet) -> bool:
+        """Decode side: land a handed-off prefill (kv_handoff.deliver).
+        True = packet consumed (decoding, or terminally failed on its own
+        admission tail); False = re-park and retry later (no free slot,
+        or the all-or-nothing destination allocation found the pool dry —
+        zero writes were issued).  Refused on prefill-role and dense
+        engines, and after close."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-role engine cannot accept a handoff — deliver to "
+                "a decode/both replica")
+        if self._pool is None:
+            raise RuntimeError(
+                "handoff needs the paged KV layout (kv_page_size > 0)")
+        return kv_handoff.deliver(self, packet)
+
+    def _reset_slot_now(self, slot: int) -> None:
+        """Immediate one-slot block-table reset + deferred-free flush,
+        for landing paths that run OUTSIDE step() (admit_prefilled): the
+        reset dispatch precedes any later tenant of the reclaimed pages
+        on the single device stream, same as step()'s batched reset."""
+        mask = np.zeros((self.slots,), bool)
+        mask[slot] = True
+        with self._compile.site("slot_reset"):
+            self.cache = self._reset(self.cache, self._dev(mask))
+        self._flush_freed_pages()
 
     def step(self) -> int:
         """One host-loop iteration: cancel → admit → decode window →
@@ -1786,8 +1946,10 @@ class InferenceEngine:
                     self._planes_dev = (self._dev(self._slot_temp),
                                         self._dev(self._slot_topp),
                                         self._dev(self._slot_topk),
+                                        self._dev(self._slot_minp),
                                         self._dev(self._slot_key))
-                temps_dev, topps_dev, topks_dev, keys_dev = self._planes_dev
+                (temps_dev, topps_dev, topks_dev, minps_dev,
+                 keys_dev) = self._planes_dev
                 t_disp = self.clock()
                 if spec:
                     with self._compile.site(f"verify_window[k{k}]"):
@@ -1795,14 +1957,15 @@ class InferenceEngine:
                             self._verify(
                                 self.params, self.cache, chunk_dev, dls_dev,
                                 self._active_dev, temps_dev, topps_dev,
-                                topks_dev, keys_dev, pos_dev)
+                                topks_dev, minps_dev, keys_dev, pos_dev)
                 else:
                     with self._compile.site(f"decode_window[k{k}]"):
                         self.cache, blk_dev, logp_dev, last_dev, pos_out = \
                             self._window(
                                 self.params, self.cache, self._tok_dev,
                                 self._active_dev, temps_dev, topps_dev,
-                                topks_dev, keys_dev, self._pos_dev)
+                                topks_dev, minps_dev, keys_dev,
+                                self._pos_dev)
                 dispatch_s = self.clock() - t_disp
             except Exception as e:
                 now = self.clock()
@@ -2066,6 +2229,20 @@ class InferenceEngine:
         if mask.any():
             self.cache = self._reset(self.cache, self._dev(mask))
         self._flush_freed_pages()
+        while self._outbox:
+            # packaged-but-undelivered handoffs: accepted work the engine
+            # quit on — cancelled with engine_fault, so the router's
+            # failover harvest re-dispatches exactly these (the replay's
+            # re-prefill is a radix hit wherever the trie survives)
+            packet = self._outbox.popleft()
+            packet.release()
+            req = packet.req
+            req.engine_fault = True
+            req.status = "cancelled"
+            req.finish_t = now
+            self._tr_close(req, status="cancelled")
+            self.completed.append(req)
+            self.stats.add(req)
         for req, _prefilled in self._pending:  # overlap-prefilled, unlanded
             req.engine_fault = True
             if req.id in self._stalled_ids:
@@ -2129,10 +2306,14 @@ class InferenceEngine:
         """
         if self._closed:
             raise RuntimeError("engine is closed")
-        if self.has_work:
+        if self.has_work or self._outbox:
+            # a parked handoff packet HOLDS pool pages and radix nodes —
+            # the wholesale trie eviction below assumes no outstanding
+            # references, so an undelivered outbox counts as busy too
             raise RuntimeError(
                 f"swap_params on a busy engine (occupied={self.occupied}, "
-                f"pending={len(self._pending)}, queued={len(self.scheduler)})"
+                f"pending={len(self._pending)}, queued={len(self.scheduler)}, "
+                f"outbox={len(self._outbox)})"
                 " — drain it first (stop submitting, pump step() until "
                 "has_work is False)")
         if self.quant == "int8":
@@ -2197,7 +2378,28 @@ class InferenceEngine:
         t0 = self.clock()
         before = self._compile.snapshot()
         slot0 = jnp.asarray(0, jnp.int32)
-        if self._prefill_chunk:
+        if self.role == "decode":
+            # decode replicas own NO prefill program: pages arrive via
+            # admit_prefilled (serving/kv_handoff.py), so the family here
+            # is first_pick + the decode window + reset + the per-page
+            # handoff writer — and the per-role census (bench_disagg)
+            # pins that no prefill[b*]/extend[b*] site ever appears
+            vocab = getattr(self.model, "num_classes")
+            last_logits = self._dev(np.zeros((1, vocab), np.float32))
+            with self._compile.site("handoff_install"):
+                # zero payload through the SAME _dev commitment the real
+                # admit_prefilled upload uses, so tp engines compile one
+                # page-writer here and reuse it for every handoff
+                payload = jax.tree.map(
+                    lambda leaf: self._dev(
+                        np.zeros(leaf.shape[1:], leaf.dtype)),
+                    pool_page_leaves(self.cache))
+                self.cache = self._page_write(self.cache, payload, slot0)
+                bt_row = self._dev(np.zeros(
+                    (self.max_len // self._page_size,), np.int32))
+                self.cache = self._bt_install(
+                    self.cache, bt_row, slot0, jnp.asarray(0, jnp.int32))
+        elif self._prefill_chunk:
             # chunked mode never dispatches bucketed prefills or the
             # dense slot insert: the resident prefill family is the ONE
             # extend[b{C}] program every chunk of every prompt runs
@@ -2219,16 +2421,29 @@ class InferenceEngine:
                     _, last_logits = self._prefill_row(
                         self.params, jnp.zeros((1, b), jnp.int32),
                         jnp.ones((1,), jnp.int32))
+        if self.role == "prefill":
+            # the source half of the handoff family: the ONE fixed-shape
+            # page gather every transferred page reads through (read-only
+            # — jitted without donation), warmed so the first packet pays
+            # zero compile
+            with self._compile.site("handoff_gather"):
+                jax.block_until_ready(self._page_gather(
+                    self.cache, jnp.asarray(0, jnp.int32)))
         # the shared first-token pick over the (1, V) prefill logits —
-        # same program whatever landing path (miss/hit/extend) runs it
-        with self._compile.site("first_pick"):
-            first_pick(last_logits,
-                       self._dev(np.zeros((1,), np.float32)),
-                       self._dev(np.zeros((1,), np.float32)),
-                       self._dev(np.zeros((1,), np.int32)),
-                       self._dev(np.zeros((1, 2), np.uint32)),
-                       self._dev(np.zeros((1,), np.int32)))
-        if not self._prefill_chunk:
+        # same program whatever landing path (miss/hit/extend/handoff)
+        # runs it.  A prefill-role engine never picks a token (the pick
+        # runs on the decode side from the handed-off logits row), so it
+        # skips this — its census carries zero pick/decode programs.
+        if self.role != "prefill":
+            with self._compile.site("first_pick"):
+                first_pick(last_logits,
+                           self._dev(np.zeros((1,), np.float32)),
+                           self._dev(np.zeros((1,), np.float32)),
+                           self._dev(np.zeros((1,), np.int32)),
+                           self._dev(np.zeros((1,), np.float32)),
+                           self._dev(np.zeros((1, 2), np.uint32)),
+                           self._dev(np.zeros((1,), np.int32)))
+        if not self._prefill_chunk and self.role != "decode":
             # a zeroed B=1 prefill row in the dense decode layout — the
             # same eval_shape probe init_cache uses, so dtypes (incl.
             # int8+scales) match what a real prefill hands to insert
@@ -2263,27 +2478,33 @@ class InferenceEngine:
                 with self._compile.site("slot_insert"):
                     self.cache = self._insert(self.cache, row_cache, slot0)
         inactive = self._dev(np.zeros((self.slots,), bool))
-        temps0 = self._dev(np.zeros((self.slots,), np.float32))
-        topps0 = self._dev(np.zeros((self.slots,), np.float32))
-        topks0 = self._dev(np.zeros((self.slots,), np.int32))
-        keys0 = self._dev(np.zeros((self.slots, 2), np.uint32))
-        pos0 = self._dev(np.zeros((self.slots,), np.int32))
-        if self._verify is not None:
-            k = self.draft_len + 1
-            with self._compile.site(f"verify_window[k{k}]"):
-                self.cache, _, _, _, _ = self._verify(
-                    self.params, self.cache,
-                    self._dev(np.full((self.slots, k), self.pad_id,
-                                      np.int32)),
-                    self._dev(np.zeros((self.slots,), np.int32)), inactive,
-                    temps0, topps0, topks0, keys0, pos0)
-        else:
-            k = self.decode_ahead
-            with self._compile.site(f"decode_window[k{k}]"):
-                self.cache, _, _, _, _ = self._window(
-                    self.params, self.cache,
-                    self._dev(np.zeros((self.slots,), np.int32)), inactive,
-                    temps0, topps0, topks0, keys0, pos0)
+        if self.role != "prefill":
+            # a prefill-role engine never dispatches a decode/verify
+            # window — the per-role census pins zero window programs there
+            temps0 = self._dev(np.zeros((self.slots,), np.float32))
+            topps0 = self._dev(np.zeros((self.slots,), np.float32))
+            topks0 = self._dev(np.zeros((self.slots,), np.int32))
+            minps0 = self._dev(np.zeros((self.slots,), np.float32))
+            keys0 = self._dev(np.zeros((self.slots, 2), np.uint32))
+            pos0 = self._dev(np.zeros((self.slots,), np.int32))
+            if self._verify is not None:
+                k = self.draft_len + 1
+                with self._compile.site(f"verify_window[k{k}]"):
+                    self.cache, _, _, _, _ = self._verify(
+                        self.params, self.cache,
+                        self._dev(np.full((self.slots, k), self.pad_id,
+                                          np.int32)),
+                        self._dev(np.zeros((self.slots,), np.int32)),
+                        inactive, temps0, topps0, topks0, minps0, keys0,
+                        pos0)
+            else:
+                k = self.decode_ahead
+                with self._compile.site(f"decode_window[k{k}]"):
+                    self.cache, _, _, _, _ = self._window(
+                        self.params, self.cache,
+                        self._dev(np.zeros((self.slots,), np.int32)),
+                        inactive, temps0, topps0, topks0, minps0, keys0,
+                        pos0)
         with self._compile.site("slot_reset"):
             self.cache = self._reset(self.cache, inactive)
         delta = CompileTracker.delta(self._compile.snapshot(), before)

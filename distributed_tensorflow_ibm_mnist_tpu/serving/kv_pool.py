@@ -249,6 +249,58 @@ def paged_reset(cache, slot_mask):
     return out
 
 
+def pool_page_leaves(cache):
+    """The ``pages_*`` leaves of a paged cache as a congruent sub-tree —
+    the payload layout one page occupies across every layer (the handoff
+    transfer unit, serving/kv_handoff.py)."""
+    return {name: {k: v for k, v in entry.items() if k.startswith("pages_")}
+            for name, entry in cache.items()}
+
+
+def gather_page(cache, page_id):
+    """One page's cross-layer payload: ``{layer: {pages_k: (ps, hkv, d),
+    ...}}`` sliced at ``page_id``.  Read-only (jit WITHOUT donation — the
+    source pool stays live until the handoff commits); ``device_get`` of
+    the result assembles shards host-side, which is what makes a tp=4
+    prefill pool's head-sharded page land as one full host array for a
+    tp=1 decode pool (the resharding seam of the disaggregated tier)."""
+    return jax.tree.map(lambda leaf: leaf[page_id], pool_page_leaves(cache))
+
+
+def page_write(cache, payload, page_id):
+    """Scatter one page's cross-layer ``payload`` (the
+    :func:`gather_page` tree, host- or device-resident) into page
+    ``page_id`` of every layer's pool.  Fixed shape at ANY prompt length
+    — the handoff installs N pages as N dispatches of this ONE program,
+    so the per-role compile census never moves with traffic.  The engine
+    jits this with the cache donated."""
+    out = {}
+    for name, entry in cache.items():
+        e = dict(entry)
+        for key in entry:
+            if key.startswith("pages_"):
+                e[key] = entry[key].at[page_id].set(
+                    payload[name][key].astype(entry[key].dtype))
+        out[name] = e
+    return out
+
+
+def bt_install(cache, bt_row, slot, cursor):
+    """Install ``slot``'s block table row and cursor across every layer —
+    the no-forward landing step of a handed-off request (its K/V pages
+    are already in the pool; only the mapping and the cursor are new).
+    The engine jits this with the cache donated."""
+    out = {}
+    for name, entry in cache.items():
+        e = dict(entry)
+        e["block_table"] = jax.lax.dynamic_update_slice(
+            entry["block_table"], bt_row[None].astype(jnp.int32), (slot, 0))
+        e["index"] = entry["index"].at[slot].set(
+            jnp.asarray(cursor, jnp.int32))
+        out[name] = e
+    return out
+
+
 def make_paged_extend(model, max_len: int, page_size: int) -> Callable:
     """Build the PARTIAL-PREFIX prefill program: ``extend(params, cache,
     slot, bt_row, suffix, start, suffix_len) -> (cache, last_logits)``.

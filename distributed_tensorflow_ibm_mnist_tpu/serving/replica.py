@@ -57,9 +57,20 @@ class Replica:
     interleaved per-engine records.
     """
 
-    def __init__(self, index: int, make_engine: Callable, tracer=None):
+    def __init__(self, index: int, make_engine: Callable, tracer=None,
+                 role: str = "both"):
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got {role!r}")
         self.index = int(index)
         self._make_engine = make_engine
+        # serving role (ISSUE 16): "both" adopts whatever role the
+        # factory's engine declares (monolithic replicas stay "both");
+        # an explicit "prefill"/"decode" is VALIDATED against the spawned
+        # engine — a replica advertised as prefill capacity whose engine
+        # would decode locally (or vice versa) is a misconfiguration, not
+        # a policy choice
+        self._role = str(role)
         try:
             n_params = len(inspect.signature(make_engine).parameters)
         except (TypeError, ValueError):  # builtins/partials w/o signature
@@ -103,6 +114,12 @@ class Replica:
         self.engine = (self._make_engine(self.tid, self.index)
                        if self._factory_wants_index
                        else self._make_engine(self.tid))
+        engine_role = getattr(self.engine, "role", "both")
+        if self._role != "both" and engine_role != self._role:
+            raise RuntimeError(
+                f"replica {self.index} declared role {self._role!r} but the "
+                f"factory built a {engine_role!r}-role engine — the router "
+                "would route the wrong traffic here")
         self.spawn_s = time.perf_counter() - t0
         self.spawn_history.append(self.spawn_s)
         self.spawns += 1
@@ -116,6 +133,15 @@ class Replica:
     @property
     def alive(self) -> bool:
         return self.engine is not None and not self.engine._closed
+
+    @property
+    def role(self) -> str:
+        """The replica's serving role: the live engine's declaration when
+        one exists (stable across respawns — the factory rebuilds the
+        same configuration), else the constructor's."""
+        if self.engine is not None:
+            return getattr(self.engine, "role", self._role)
+        return self._role
 
     def probe(self) -> bool:
         """Liveness check the router runs each step on HEALTHY replicas.
@@ -133,7 +159,12 @@ class Replica:
         e = self.engine
         if e is None:
             return float("inf")
-        ahead = len(e.scheduler) + len(e._pending) + e.occupied
+        # role-aware (ISSUE 16): a prefill replica's outbox is accepted
+        # work not yet delivered — its pages are still held, so it counts
+        # ahead of a new arrival exactly like a parked request (empty on
+        # both/decode replicas, where the term vanishes)
+        ahead = (len(e.scheduler) + len(e._pending) + e.occupied
+                 + len(getattr(e, "_outbox", ())))
         frac = (e._pool.allocated / e._pool.capacity
                 if e._pool is not None else e.occupied / e.slots)
         return ahead + frac
@@ -151,6 +182,9 @@ class Replica:
             self._heartbeat_t = e.heartbeat_t
         return {
             "state": self.state,
+            "role": self.role,
+            "outbox": (len(e._outbox)
+                       if e is not None and hasattr(e, "_outbox") else 0),
             "alive": self.alive,
             "spawns": self.spawns,
             "swaps": self.swaps,

@@ -46,13 +46,29 @@ absorb traffic) → ``engine.swap_params`` (stale prefix/radix caches
 dropped) → re-admit.  Zero requests drop by construction: draining never
 cancels, and N−1 replicas keep serving throughout.
 
+Disaggregation (ISSUE 16) — ``roles=`` types each replica: admissions
+dispatch only to ``prefill``/``both`` capacity (least-loaded among them),
+and each router step drains the prefill replicas' outboxes of finished
+prefills (:mod:`~.kv_handoff` packets), delivering each to the
+least-loaded ``decode``/``both`` replica via ``admit_prefilled``.  A
+destination that cannot take a packet RIGHT NOW (no free slot, dry pool)
+re-parks it on its source — admission-stall semantics, retried every
+pump — and the source-side page hold is released only on confirmed
+delivery (deferred source-free), so a transfer that dies anywhere leaves
+the request re-dispatchable down the normal prefill path.  A tier with no
+role-typed replica (all ``"both"``, the default) takes ZERO handoff
+paths — the monolithic behavior is unchanged.
+
 Chaos sites (utils/chaos.py): ``router-dispatch`` fires once per
 router→replica dispatch attempt — a hit excludes that replica for THAT
 request and retries the next-best survivor; ``weight-swap`` fires once per
 swap attempt after the drain and before the params replacement — a hit
 re-admits the replica on its OLD weights (the swap is all-or-nothing) and
-the watcher retries at the next poll.  Both follow the engine's nil-guard
-pattern: zero chaos instructions when unwired.
+the watcher retries at the next poll; ``kv-handoff`` fires once per
+handoff delivery attempt — a hit releases the source hold and re-dispatches
+the request (the delivered high-water mark keeps the replay exactly-once).
+All follow the engine's nil-guard pattern: zero chaos instructions when
+unwired.
 
 Tracing: all replicas share ONE tracer; each gets its own track
 (``replica <i>``), so N host loops render as N lanes, with
@@ -68,6 +84,7 @@ never needs internal locks of its own.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import time
 from typing import Callable
@@ -200,9 +217,12 @@ class Router:
                  chaos=None, tracer=None, writer=None,
                  probe: Callable | None = None,
                  max_drain_steps: int = 10_000,
-                 telemetry=None):
+                 telemetry=None, roles: list | None = None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if roles is not None and len(roles) != n_replicas:
+            raise ValueError(
+                f"roles has {len(roles)} entries for {n_replicas} replicas")
         self.clock = clock
         self._chaos = chaos
         self._tracer = tracer
@@ -218,10 +238,31 @@ class Router:
         self._probe = probe
         self.max_drain_steps = int(max_drain_steps)
         self.tid = tracer.track("router") if tracer is not None else 0
-        self.replicas = [Replica(i, make_engine, tracer=tracer)
-                         for i in range(n_replicas)]
+        self.replicas = [
+            Replica(i, make_engine, tracer=tracer,
+                    role=(roles[i] if roles is not None else "both"))
+            for i in range(n_replicas)]
         for rep in self.replicas:
             rep.spawn()
+        if roles is not None and not any(
+                r.role in ("prefill", "both") for r in self.replicas):
+            raise ValueError(
+                "roles leaves no prefill-capable replica — nothing could "
+                "ever admit a prompt")
+        if roles is not None and not any(
+                r.role in ("decode", "both") for r in self.replicas):
+            raise ValueError(
+                "roles leaves no decode-capable replica — nothing could "
+                "ever produce a token")
+        self.handoffs = 0        # packets delivered prefill → decode
+        self.handoff_faults = 0  # kv-handoff chaos hits (re-dispatched)
+        # daemon seam: ``admit_prefilled`` mutates the DESTINATION engine,
+        # which in the daemonized tier is concurrently stepped by its own
+        # pump thread — the daemon installs a per-replica lock factory
+        # here (``_admit_guard(replica) -> context manager``) so the
+        # landing serializes with that pump.  The step-pumped tier is
+        # single-threaded and leaves it None (zero overhead).
+        self._admit_guard: Callable | None = None
         self._ids = itertools.count()
         self.requests: list[RouterRequest] = []   # submit order, forever
         # engine Request (by object identity) -> owning RouterRequest: the
@@ -288,9 +329,13 @@ class Router:
         """
         full: list[Replica] = []
         while True:
+            # admissions go to PREFILL capacity: decode-role replicas take
+            # no prompts (their engines refuse submit() outright) — their
+            # work arrives as handoff packets through _pump_handoffs
             cands = sorted(
                 (r for r in self.healthy()
-                 if r.index not in rr.excluded and r not in full),
+                 if r.role in ("prefill", "both")
+                 and r.index not in rr.excluded and r not in full),
                 key=lambda r: r.load)
             if not cands:
                 if full:
@@ -382,11 +427,128 @@ class Router:
                                 "failover_error", cat="router", tid=rep.tid,
                                 replica=rep.index,
                                 error=f"{type(fe).__name__}: {fe}")
+        self._pump_handoffs()
         if self._orphans:
             self._retry_orphans()
         if self._telemetry is not None:
             self._telemetry.maybe_sample()
         return produced
+
+    # ------------------------------------------------------------------
+    # prefill → decode handoff (disaggregated tiers; module docstring)
+
+    def _handoff_target(self, rr: RouterRequest | None):
+        """Least-loaded healthy DECODE-capable replica eligible for this
+        request, or None (re-park and retry next pump)."""
+        excluded = rr.excluded if rr is not None else set()
+        cands = sorted(
+            (r for r in self.healthy()
+             if r.role in ("decode", "both") and r.index not in excluded),
+            key=lambda r: r.load)
+        return cands[0] if cands else None
+
+    def _pump_handoffs(self) -> int:
+        """Drain every live prefill-capable replica's outbox, delivering
+        each packet to decode capacity.  Undeliverable packets re-park on
+        their SOURCE outbox (pages still held — deferred source-free), so
+        a source that later dies converts them to engine_fault casualties
+        via its close() and the ordinary failover harvest.  Returns
+        packets delivered this pump."""
+        delivered = 0
+        for rep in self.replicas:
+            if rep.state == FAILED or not rep.alive:
+                continue
+            outbox = getattr(rep.engine, "_outbox", None)
+            if not outbox:
+                continue
+            for _ in range(len(outbox)):
+                packet = outbox.popleft()
+                rr = self._owner.get(id(packet.req))
+                if rr is not None and rr.req is not packet.req:
+                    # a stale attempt's packet (the request already failed
+                    # over while parked): the hold is all that's left
+                    packet.release()
+                    continue
+                if rr is not None and self.clock() > rr.overdue_at:
+                    packet.release()
+                    rr.final_status = "cancelled"
+                    rep.engine._tr_close(packet.req, status="cancelled")
+                    continue
+                if self._chaos is not None:
+                    # one kv-handoff event per delivery ATTEMPT: a hit is
+                    # the transfer dying in flight
+                    spec = self._chaos.fire("kv-handoff")
+                    if spec is not None:
+                        self.handoff_faults += 1
+                        self._handoff_fault(rep, packet, rr, spec)
+                        continue
+                dest = self._handoff_target(rr)
+                if dest is None:
+                    outbox.append(packet)
+                    continue
+                guard = (self._admit_guard(dest)
+                         if self._admit_guard is not None
+                         else contextlib.nullcontext())
+                try:
+                    with guard:
+                        ok = dest.engine.admit_prefilled(packet)
+                except Exception as e:
+                    # engine-wide destination fault (the landing tail's
+                    # own failures return True): re-park, fail the dest —
+                    # its harvest runs now, the packet retries next pump
+                    outbox.append(packet)
+                    if dest.state != FAILED:
+                        self._fail_replica(dest, e)
+                    continue
+                if not ok:
+                    outbox.append(packet)   # no slot / dry pool: stall
+                    continue
+                packet.release()
+                delivered += 1
+                self.handoffs += 1
+                if rr is not None:
+                    rr.replica = dest.index
+                if self._tracer is not None:
+                    self._tracer.instant(
+                        "handoff_delivered", cat="router", tid=dest.tid,
+                        request=getattr(packet.req, "id", None),
+                        source=rep.index, replica=dest.index,
+                        pages=len(packet.payloads),
+                        bytes=packet.payload_bytes)
+        return delivered
+
+    def _handoff_fault(self, rep: Replica, packet, rr: RouterRequest | None,
+                       spec) -> None:
+        """A kv-handoff chaos hit: the in-flight transfer died.  Release
+        the source hold, close out the dead attempt, and re-dispatch the
+        request down the normal prefill path — the source is NOT excluded
+        (its trie still holds the prompt's blocks, making it the cheapest
+        retry), and the delivered high-water mark keeps the replayed
+        prefix exactly-once."""
+        packet.release()
+        req = packet.req
+        req.engine_fault = True
+        req.status = "cancelled"
+        req.finish_t = self.clock()
+        rep.engine._tr_close(req, status="cancelled")
+        rep.engine.completed.append(req)
+        rep.engine.stats.add(req)
+        if self._tracer is not None:
+            self._tracer.instant(
+                "handoff_fault", cat="router", tid=rep.tid,
+                request=getattr(req, "id", None), source=rep.index,
+                fault_kind=spec.kind)
+        if rr is None or rr.req is not req:
+            return
+        rr.redispatches += 1
+        try:
+            self._dispatch(rr)
+        except (QueueFull, NoHealthyReplica) as e:
+            if isinstance(e, NoHealthyReplica) and not self.healthy():
+                rr.final_status = "failed"
+                rr.final_error = f"{type(e).__name__}: {e}"
+                return
+            self._orphans.append(rr)
 
     def _telemetry_vitals(self) -> dict:
         """Health-sampler source: cluster counters + per-replica vitals
@@ -400,6 +562,8 @@ class Router:
             "router_requests": len(self.requests),
             "outstanding": sum(1 for rr in self.requests if not rr.done),
             "weight_swaps": len(self.swapped_steps),
+            "handoffs": self.handoffs,
+            "handoff_faults": self.handoff_faults,
             "replicas": {str(r.index): r.vitals() for r in self.replicas},
         }
 
@@ -547,7 +711,11 @@ class Router:
             self._tracer.instant("swap_drain_begin", cat="router",
                                  tid=rep.tid, replica=rep.index)
         steps = 0
-        while rep.engine is not None and rep.alive and rep.engine.has_work:
+        # a parked handoff packet holds pool pages and radix nodes, so a
+        # non-empty outbox is in-flight work for the drain: swap_params
+        # evicts the trie wholesale and must not free pages a packet holds
+        while rep.engine is not None and rep.alive and (
+                rep.engine.has_work or len(getattr(rep.engine, "_outbox", ()))):
             self.step()  # the whole tier keeps moving while rep drains
             steps += 1
             if steps >= self.max_drain_steps:
@@ -619,6 +787,8 @@ class Router:
             "redispatches": sum(rr.redispatches for rr in self.requests),
             "router_requests": len(self.requests),
             "weight_swaps": sum(r.swaps for r in self.replicas),
+            "handoffs": self.handoffs,
+            "handoff_faults": self.handoff_faults,
             "swapped_steps": list(self.swapped_steps),
             "spawn_s_by_replica": [
                 [round(s, 6) for s in r.spawn_history] for r in self.replicas],

@@ -1,10 +1,10 @@
 """Per-request sampling for the serving tier (ISSUE 13).
 
 A request carries a :class:`SamplingParams` — ``(temperature, top_p,
-top_k, seed)`` — validated at submit time, and the engine turns the
-per-slot values into device-side DATA planes: (slots,) float32
-temperature and top-p vectors, a (slots,) int32 top-k vector (ISSUE 14),
-plus a (slots, 2) uint32 base-key plane, all fed to the
+top_k, min_p, seed)`` — validated at submit time, and the engine turns
+the per-slot values into device-side DATA planes: (slots,) float32
+temperature, top-p, and min-p vectors, a (slots,) int32 top-k vector
+(ISSUE 14), plus a (slots, 2) uint32 base-key plane, all fed to the
 SAME compiled decode/verify programs regardless of the mix (the
 one-program-many-behaviors discipline the census gates pin; see
 core/generate.py ``_pick_rows`` / ``_sample_window_core`` /
@@ -45,11 +45,13 @@ from distributed_tensorflow_ibm_mnist_tpu.core.generate import _pick_rows
 class SamplingParams:
     """Validated per-request sampling config.
 
-    ``temperature == 0`` is greedy (argmax; ``top_p``/``top_k`` must be 0
-    and the seed is inert), ``temperature > 0`` samples the tempered
-    distribution, optionally truncated to the ``top_k`` highest-logit
-    tokens and/or nucleus-filtered by ``0 < top_p < 1`` (top-k applies
-    first, like the offline generator).  ``seed`` fully
+    ``temperature == 0`` is greedy (argmax; ``top_p``/``top_k``/``min_p``
+    must be 0 and the seed is inert), ``temperature > 0`` samples the
+    tempered distribution, optionally truncated to the ``top_k``
+    highest-logit tokens, nucleus-filtered by ``0 < top_p < 1`` (top-k
+    applies first, like the offline generator), and/or min-p-filtered by
+    ``0 < min_p <= 1`` (tokens below ``min_p * max_prob`` cut, applied
+    last; ``min_p = 1`` keeps only the argmax).  ``seed`` fully
     determines the request's token stream at fixed params/prompt —
     submit the same seed twice and the streams are token-identical;
     best-of-n is "same prompt, n seeds" (examples/11_sampling.py).
@@ -58,10 +60,12 @@ class SamplingParams:
     temperature: float = 0.0
     top_p: float = 0.0
     top_k: int = 0
+    min_p: float = 0.0
     seed: int = 0
 
     def __post_init__(self):
         t, p, k, s = self.temperature, self.top_p, self.top_k, self.seed
+        mp = self.min_p
         if not (isinstance(t, (int, float)) and np.isfinite(t) and t >= 0):
             raise ValueError(
                 f"temperature must be a finite float >= 0, got {t!r}")
@@ -76,6 +80,11 @@ class SamplingParams:
         if k and t == 0:
             raise ValueError(
                 "top_k filters a SAMPLING distribution; set temperature > 0")
+        if not (isinstance(mp, (int, float)) and 0.0 <= float(mp) <= 1.0):
+            raise ValueError(f"min_p must be in [0, 1], got {mp!r}")
+        if mp and t == 0:
+            raise ValueError(
+                "min_p filters a SAMPLING distribution; set temperature > 0")
         if not isinstance(s, (int, np.integer)) or isinstance(s, bool):
             raise ValueError(f"seed must be an int, got {s!r}")
         if not 0 <= int(s) < (1 << 64):
@@ -104,13 +113,13 @@ def base_key(seed: int) -> np.ndarray:
 
 
 @jax.jit
-def first_pick(logits, temps, topps, topks, keys, pos):
+def first_pick(logits, temps, topps, topks, minps, keys, pos):
     """The shared first-token pick program: fold each row's base key at
     its generated index (0 for a fresh request) and pick with the same
     data-driven math the decode window uses.  Module-level jit: every
-    engine in the process shares one compilation per shape (top-k rides
-    the ``topks`` DATA plane — ISSUE 14), and prefix-cache hit/miss
-    paths are bit-identical by construction.
+    engine in the process shares one compilation per shape (top-k and
+    min-p ride the ``topks``/``minps`` DATA planes), and prefix-cache
+    hit/miss paths are bit-identical by construction.
     Returns ``((B,) int32 token, (B,) float32 logprob)``."""
     step_keys = jax.vmap(jax.random.fold_in)(keys, pos)
-    return _pick_rows(logits, temps, topps, topks, step_keys)
+    return _pick_rows(logits, temps, topps, topks, minps, step_keys)

@@ -127,11 +127,16 @@ class ServingStats:
     """
 
     def __init__(self, slots: int, decode_ahead: int = 1,
-                 sample_cap: int = 2048):
+                 sample_cap: int = 2048, role: str = "both"):
         if sample_cap < 1:
             raise ValueError(f"sample_cap must be >= 1, got {sample_cap}")
         self.slots = slots
         self.decode_ahead = decode_ahead
+        # which serving role produced this record ("both" = monolithic;
+        # "prefill"/"decode" = a disaggregated tier — ISSUE 16).  The
+        # router rollup groups per-role so prefill-side figures (chunk
+        # stalls, radix skips) never blend into decode-side TPOT.
+        self.role = str(role)
         self._lock = threading.RLock()
         # bounded percentile-sample reservoir (Algorithm R; see module
         # docstring).  Counters below are EXACT regardless of the cap;
@@ -387,6 +392,7 @@ class ServingStats:
         )
         out = {
             "slots": self.slots,
+            "role": self.role,
             "n_requests": self._n_requests,
             "n_done": self._n_done,
             "n_cancelled": self._n_cancelled,
@@ -733,9 +739,55 @@ class ServingStats:
             "compile_time_s": (
                 round(sum(c["compile_time_s"] for c in compiled), 6)
                 if compiled else None),
+            "per_role": cls._role_rollups(records),
             "per_engine": [rec.summary() for rec in records],
         }
         for name, xs in (("ttft_s", ttft), ("latency_s", latency)):
             for k, v in percentiles(xs).items():
                 out[f"{name}_{k}"] = v
+        return out
+
+    @classmethod
+    def _role_rollups(cls, records: list["ServingStats"]) -> dict:
+        """Per-role sub-rollups (ISSUE 16): group engine records by the
+        serving role that produced them so a disaggregated tier's rollup
+        separates prefill-side figures (chunk dispatches, radix skips,
+        page pressure) from decode-side service latency.  TTFT/latency
+        land where requests RETIRE — the decode side in a disaggregated
+        tier — so the decode sub-rollup carries the user-visible
+        percentiles plus TPOT (time-per-output-token over the post-first-
+        token stretch), while the prefill sub-rollup shows the work that
+        never retires a request locally.  A monolithic tier reports one
+        ``"both"`` entry; every ratio/percentile is None — never NaN —
+        when its denominator is empty (strict-JSON, like everything else
+        in the record).  Callers hold every record's lock (``merge``).
+        """
+        out: dict[str, dict] = {}
+        for role in sorted({rec.role for rec in records}):
+            recs = [rec for rec in records if rec.role == role]
+            reqs = [r for rec in recs for r in rec.requests]
+            done = [r for r in reqs if r.status == "done"]
+            ttft = [r.first_token_t - r.submit_t for r in reqs
+                    if r.first_token_t is not None]
+            tpot = [(r.finish_t - r.first_token_t) / (len(r.generated) - 1)
+                    for r in done
+                    if r.finish_t is not None and r.first_token_t is not None
+                    and len(r.generated) > 1]
+            sub = {
+                "n_engines": len(recs),
+                "n_requests": sum(rec._n_requests for rec in recs),
+                "n_done": sum(rec._n_done for rec in recs),
+                "tokens_generated": sum(rec._tokens for rec in recs),
+                "busy_s": round(sum(rec._busy_time for rec in recs), 6),
+                "n_prefill_chunks": sum(rec._prefill_chunks
+                                        for rec in recs),
+                "radix_hits": sum(rec._radix_hits for rec in recs),
+                "radix_hit_tokens": sum(rec._radix_hit_tokens
+                                        for rec in recs),
+                "kv_pages_peak": sum(rec._kv_pages_peak for rec in recs),
+            }
+            for name, xs in (("ttft_s", ttft), ("tpot_s", tpot)):
+                for k, v in percentiles(xs).items():
+                    sub[f"{name}_{k}"] = v
+            out[role] = sub
         return out

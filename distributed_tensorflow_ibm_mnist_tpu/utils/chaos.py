@@ -55,6 +55,16 @@ Injection sites (consulted by the subsystems named in parentheses):
                           its OLD weights (still consistent — the swap is
                           all-or-nothing) and the watcher retries at the
                           next poll.
+``kv-handoff``            one event per prefill→decode handoff delivery
+                          attempt (serving/router.py, disaggregated
+                          tiers only); raises — the transfer of a
+                          finished prefill's KV pages dying in flight.
+                          The router releases the source-side hold and
+                          re-dispatches the request down the normal
+                          prefill path (radix-aware: the retry's prefill
+                          is cheap when the source trie survived), and
+                          the delivered high-water mark keeps the replay
+                          exactly-once per token.
 ``daemon-pump``           one event per pump-thread activation
                           (serving/daemon.py): a pump consults the site
                           the first time it finds work to serve after
@@ -108,6 +118,7 @@ SITES = (
     "router-dispatch",
     "weight-swap",
     "daemon-pump",
+    "kv-handoff",
 )
 
 

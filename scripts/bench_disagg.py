@@ -1,0 +1,348 @@
+"""Disaggregated prefill/decode serving bench (ISSUE 16).
+
+Measures the headline of the role-typed tier: SHORT-request TTFT stays
+flat while a long-prompt stream saturates prefill capacity, because
+prefill-role replicas free their slot at packaging (the whole queue
+drains every step) and the paged-KV handoff lands on separately-sized
+decode capacity.  On the monolithic tier the same slots serve both
+phases, so prompt work and decode tenancy contend for one budget.
+
+Latency is measured in ROUTER STEPS, not wall microseconds: the driver
+is a deterministic drip (arrivals pinned to step indices, greedy
+sampling, fixed seeds), so TTFT-in-steps is a property of the queueing
+structure and reproduces exactly — the "latency-structured" form of the
+standing CPU caveat (tiny model, emulated devices: wall numbers are
+reported for contrast but never gated, and no tokens/sec is claimed).
+
+Legs over a tiny causal-LM (CPU-sized), buckets (8, 16), paged KV:
+
+1. **control** — unloaded disaggregated tier (prefill(2) + decode(8)
+   slots): the short drip alone.  TTFT p99 (steps) is the baseline.
+2. **loaded** — the same short drip while a 1-per-step long-prompt
+   stream saturates the prefill replica.  GATE: short TTFT p99 (steps)
+   within 1.15x of the control — the disaggregation headline.  Every
+   request must hand off exactly once (handoffs == requests).
+3. **monolithic** — the identical mixed schedule on an equal-total-slot
+   monolithic tier (2 x both(5)): measured and reported for contrast
+   (short TTFT steps + wall, per-step wall).  GATE: token parity — the
+   full mixed stream must generate token-for-token what the
+   disaggregated tier generated (greedy; any mismatch exits nonzero).
+   On a CPU-sized, slot-abundant tier the monolithic short TTFT can
+   stay flat too; the structural contrast the bench pins instead is the
+   census (leg 5): monolithic replicas carry the full program family in
+   every slot, role-typed replicas provably carry only their half.
+4. **chaos** — the mixed drip with a ``kv-handoff`` fault on the first
+   delivery attempt: the router releases the hold, re-dispatches
+   through a fresh prefill, and the delivered high-water keeps streams
+   exactly-once.  GATES: zero drops (all done), stream == final tokens
+   per request, >= 1 fault actually fired, pools at refcount zero after.
+5. **census** — per-role compile pins from ``prewarm()["by_site"]``:
+   decode replicas compile ZERO prefill/extend/insert programs, prefill
+   replicas ZERO pick/window programs; and serving compiles NOTHING
+   beyond prewarm (post-serve program delta == 0 on both tiers).
+
+Usage:  JAX_PLATFORMS=cpu python scripts/bench_disagg.py
+Emits one JSON line (``"metric": "disagg"``); exits nonzero when any
+gate fails.  ``DTM_BENCH_QUICK=1`` shrinks the drip to a tier-1-safe
+subprocess smoke.  bench.py runs this as its ``disagg`` block
+(``DTM_BENCH_SKIP_DISAGG=1`` skips).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+QUICK = os.environ.get("DTM_BENCH_QUICK", "") not in ("", "0")
+
+MODEL_KW = dict(num_classes=16, dim=32, depth=1, heads=2,
+                dtype=jnp.float32)
+BUCKETS = (8, 16)
+MAX_LEN = 32
+PAGE = 4
+KV_PAGES = 96
+LONG_LEN, LONG_NEW = 12, 5     # bucket-16 prompt, holds a decode slot
+SHORT_LEN, SHORT_NEW = 3, 2    # bucket-8 prompt, two tokens
+N_LONGS = 8 if QUICK else 24   # one per step: the saturating stream
+N_SHORTS = 3 if QUICK else 8   # dripped every 3rd step
+SHORT_EVERY = 3
+MAX_STEPS = 3000
+
+DISAGG_ROLES = ["prefill", "decode"]
+DISAGG_SLOTS = [2, 8]
+MONO_SLOTS = [5, 5]            # equal total decode-capable slots (10)
+
+
+def _prompts(seed: int):
+    rng = np.random.default_rng(seed)
+    longs = [rng.integers(1, 16, size=(LONG_LEN,)).astype(np.int32)
+             for _ in range(N_LONGS)]
+    shorts = [rng.integers(1, 16, size=(SHORT_LEN,)).astype(np.int32)
+              for _ in range(N_SHORTS)]
+    return longs, shorts
+
+
+def _arrivals(longs, shorts, *, with_longs: bool):
+    """The drip schedule: long k arrives at step k (1/step — saturating),
+    short j at step 1 + 3j, longs first within a step so shorts genuinely
+    queue behind them."""
+    arr = []
+    if with_longs:
+        for k, p in enumerate(longs):
+            arr.append({"step": k, "kind": "long", "prompt": p,
+                        "max_new": LONG_NEW})
+    for j, p in enumerate(shorts):
+        arr.append({"step": 1 + SHORT_EVERY * j, "kind": "short",
+                    "prompt": p, "max_new": SHORT_NEW})
+    arr.sort(key=lambda a: (a["step"], a["kind"] != "long"))
+    return arr
+
+
+def _build(roles, slots, chaos=None):
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+        Router,
+    )
+
+    model = get_model("causal_lm", **MODEL_KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def make_engine(tid, index):
+        return InferenceEngine(
+            model, params, slots=slots[index], max_len=MAX_LEN,
+            kv_page_size=PAGE, kv_pages=KV_PAGES,
+            scheduler=FIFOScheduler(max_len=MAX_LEN, buckets=BUCKETS,
+                                    max_queue=64),
+            trace_tid=tid, chaos=chaos,
+            role=(roles[index] if roles is not None else "both"))
+
+    router = Router(make_engine, len(slots), roles=roles, chaos=chaos)
+    warm = router.prewarm()
+    return router, warm
+
+
+def _drive(router, arrivals):
+    """Deterministic step-pumped driver: submit each arrival just before
+    its pinned step, record the step (and wall time) of every request's
+    first delivered token.  Returns (records, per-step wall seconds)."""
+    cur = [0]
+    recs, walls = [], []
+    i = 0
+    while i < len(arrivals) or router.outstanding:
+        step = cur[0]
+        while i < len(arrivals) and arrivals[i]["step"] <= step:
+            a = arrivals[i]
+            i += 1
+            rec = {"kind": a["kind"], "submit_step": step,
+                   "submit_t": time.monotonic(),
+                   "first_step": None, "first_t": None, "stream": []}
+
+            def _cb(rr, tok, rec=rec):
+                rec["stream"].append(int(tok))
+                if rec["first_step"] is None:
+                    rec["first_step"] = cur[0]
+                    rec["first_t"] = time.monotonic()
+
+            rec["rr"] = router.submit(a["prompt"], a["max_new"],
+                                      callback=_cb)
+            recs.append(rec)
+        t0 = time.monotonic()
+        router.step()
+        walls.append(time.monotonic() - t0)
+        cur[0] = step + 1
+        if cur[0] > MAX_STEPS:
+            raise RuntimeError(f"drive exceeded {MAX_STEPS} steps "
+                               f"({router.outstanding} outstanding)")
+    return recs, walls
+
+
+def _ttft_steps(recs, kind: str):
+    return sorted(r["first_step"] - r["submit_step"] + 1 for r in recs
+                  if r["kind"] == kind and r["first_step"] is not None)
+
+
+def _leg(recs, walls) -> dict:
+    shorts = _ttft_steps(recs, "short")
+    ttft_ms = sorted((r["first_t"] - r["submit_t"]) * 1e3 for r in recs
+                     if r["kind"] == "short" and r["first_t"] is not None)
+    return {
+        "requests": len(recs),
+        "done": sum(r["rr"].status == "done" for r in recs),
+        "steps": len(walls),
+        "short_ttft_steps_p50": (float(np.percentile(shorts, 50))
+                                 if shorts else None),
+        "short_ttft_steps_p99": (float(np.percentile(shorts, 99))
+                                 if shorts else None),
+        "short_ttft_ms_p99": (round(float(np.percentile(ttft_ms, 99)), 3)
+                              if ttft_ms else None),
+        "step_wall_ms_p50": round(float(np.percentile(walls, 50)) * 1e3, 3),
+    }
+
+
+def _pools_zero(router) -> bool:
+    """Every live pool back to refcount zero: pages still allocated are
+    trie-owned prefix pages (reclaimable by design), nothing request- or
+    packet-held."""
+    for rep in router.replicas:
+        if not rep.alive or rep.engine is None or rep.engine._pool is None:
+            continue
+        eng = rep.engine
+        if eng._radix is not None:
+            stack = [eng._radix.root]
+            while stack:
+                node = stack.pop()
+                if node.ref != 0:
+                    return False
+                stack.extend(node.children.values())
+            if eng._pool.allocated != eng._radix.n_blocks:
+                return False
+        elif eng._pool.allocated != 0:
+            return False
+    return True
+
+
+def _census(warm, roles) -> dict:
+    """Per-role program pins from the prewarm reports."""
+    out = {}
+    for idx, rep in warm["replicas"].items():
+        sites = sorted(rep["by_site"])
+        role = roles[int(idx)] if roles is not None else "both"
+        prefill_sites = [s for s in sites if s.startswith(
+            ("prefill[", "extend[", "slot_insert"))]
+        decode_sites = [s for s in sites if s.startswith(
+            ("first_pick", "decode_window[", "verify_window["))]
+        out[str(idx)] = {"role": role, "sites": sites,
+                         "prefill_sites": prefill_sites,
+                         "decode_sites": decode_sites}
+    return out
+
+
+def main() -> None:
+    from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+        FaultInjector,
+        FaultPlan,
+        FaultSpec,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        CompileTracker,
+    )
+
+    tracker = CompileTracker.install()
+    longs, shorts = _prompts(7)
+
+    # -- legs 1+2: disaggregated control, then loaded -------------------
+    router, warm_d = _build(DISAGG_ROLES, DISAGG_SLOTS)
+    census_d = _census(warm_d, DISAGG_ROLES)
+    # one long + one short of warmup traffic: the first request through a
+    # fresh process compiles a handful of host-glue programs prewarm
+    # can't reach (scalar conversions outside any site); the census gate
+    # pins the STEADY state — zero programs after first traffic
+    _drive(router, _arrivals(longs[:1], shorts[:1], with_longs=True))
+    snap = tracker.snapshot()
+    recs_c, walls_c = _drive(router, _arrivals(longs, shorts,
+                                               with_longs=False))
+    handoffs0 = router.handoffs
+    recs_l, walls_l = _drive(router, _arrivals(longs, shorts,
+                                               with_longs=True))
+    serve_delta_d = CompileTracker.delta(tracker.snapshot(), snap)
+    control, loaded = _leg(recs_c, walls_c), _leg(recs_l, walls_l)
+    loaded["handoffs"] = router.handoffs - handoffs0
+    disagg_tokens = [list(r["rr"].generated) for r in recs_l]
+    pools_d = _pools_zero(router)
+    router.close()
+
+    # -- leg 3: monolithic contrast + token parity ----------------------
+    router_m, warm_m = _build(None, MONO_SLOTS)
+    snap = tracker.snapshot()
+    recs_m, walls_m = _drive(router_m, _arrivals(longs, shorts,
+                                                 with_longs=True))
+    serve_delta_m = CompileTracker.delta(tracker.snapshot(), snap)
+    mono = _leg(recs_m, walls_m)
+    mono_tokens = [list(r["rr"].generated) for r in recs_m]
+    router_m.close()
+    parity = disagg_tokens == mono_tokens and all(disagg_tokens)
+
+    # -- leg 4: kv-handoff chaos — exactly-once under a dropped packet --
+    inj = FaultInjector(FaultPlan(seed=5, faults=(
+        FaultSpec(site="kv-handoff", at=(0,)),)))
+    router_x, _ = _build(DISAGG_ROLES, DISAGG_SLOTS, chaos=inj)
+    recs_x, _ = _drive(router_x, _arrivals(longs[:4], shorts[:2],
+                                           with_longs=True))
+    chaos = {
+        "requests": len(recs_x),
+        "done": sum(r["rr"].status == "done" for r in recs_x),
+        "handoff_faults": router_x.handoff_faults,
+        "redispatches": sum(r["rr"].redispatches for r in recs_x),
+        "exactly_once": all(r["stream"] == list(r["rr"].generated)
+                            for r in recs_x),
+        "pools_zero": _pools_zero(router_x),
+        "faults": inj.summary(),
+    }
+    router_x.close()
+
+    # -- gates ----------------------------------------------------------
+    p99_c = control["short_ttft_steps_p99"] or 0.0
+    p99_l = loaded["short_ttft_steps_p99"] or float("inf")
+    by_role = {c["role"]: c for c in census_d.values()}
+    gates = {
+        "ttft_flat": p99_l <= 1.15 * p99_c,
+        "all_done": all(leg["done"] == leg["requests"]
+                        for leg in (control, loaded, mono)),
+        "every_request_handed_off": loaded["handoffs"] == len(recs_l),
+        "token_parity": parity,
+        "census_decode_role_pure": (
+            by_role["decode"]["prefill_sites"] == []
+            and by_role["decode"]["decode_sites"] != []),
+        "census_prefill_role_pure": (
+            by_role["prefill"]["decode_sites"] == []
+            and by_role["prefill"]["prefill_sites"] != []),
+        "no_post_prewarm_compiles": (
+            serve_delta_d["n_compiled_programs"] == 0
+            and serve_delta_m["n_compiled_programs"] == 0),
+        "chaos_fault_fired": chaos["handoff_faults"] >= 1,
+        "chaos_zero_drops": chaos["done"] == chaos["requests"],
+        "chaos_exactly_once": chaos["exactly_once"],
+        "pools_zero": pools_d and chaos["pools_zero"],
+    }
+    record = {
+        "metric": "disagg",
+        "quick": QUICK,
+        "tiers": {
+            "disagg": {"roles": DISAGG_ROLES, "slots": DISAGG_SLOTS},
+            "monolithic": {"roles": None, "slots": MONO_SLOTS},
+        },
+        "stream": {"longs": N_LONGS, "shorts": N_SHORTS,
+                   "long_len": LONG_LEN, "long_new": LONG_NEW,
+                   "short_len": SHORT_LEN, "short_new": SHORT_NEW},
+        "control": control,
+        "loaded": loaded,
+        "monolithic": mono,
+        "ttft_ratio": (round(p99_l / p99_c, 4) if p99_c else None),
+        "chaos": chaos,
+        "census": {"disagg": census_d, "monolithic": _census(warm_m, None),
+                   "post_prewarm_programs": {
+                       "disagg": serve_delta_d["n_compiled_programs"],
+                       "monolithic": serve_delta_m["n_compiled_programs"]}},
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+    print(json.dumps(record), flush=True)
+    if not record["passed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,7 +15,10 @@ viewer-independent identity every exported span carries), and prints
   scheduler hand-off and host-loop slack live there).  When the trace
   carries speculative-decoding spans (ISSUE 9), each request also rolls
   up its summed draft/verify/accept milliseconds and an ``accept_rate``
-  column (accepted/drafted over the request's verify windows);
+  column (accepted/drafted over the request's verify windows); on a
+  disaggregated tier (ISSUE 16), ``cat="handoff"`` spans roll up into a
+  per-request ``handoff_ms`` column (gather + install split, page and
+  dedup-page counts) — the cost of moving a prefill between engines;
 * the **instant and counter digest** — faults, restarts, cache hits, and
   per-track counter rollups (``queue_depth``, ``occupied_slots``:
   min/mean/max/last over the recorded change points — ISSUE 11), so a
@@ -132,6 +135,33 @@ def analyze(doc: dict) -> dict:
         d["accept_rate"] = (round(d["accepted"] / d["drafted"], 4)
                             if d["drafted"] > 0 else None)
 
+    # disaggregated-handoff rollup (ISSUE 16): per request, the summed
+    # gather (source) + install (destination) transfer time and the page
+    # counts the handoff spans carry — the per-request cost of moving a
+    # prefill between engines
+    handoff_by_req: dict[int, dict] = {}
+    for e in spans:
+        if e.get("cat") != "handoff":
+            continue
+        rid = _owning_request(e)
+        if rid is None:
+            continue
+        d = handoff_by_req.setdefault(rid, {
+            "handoff_ms": 0.0, "gather_ms": 0.0, "install_ms": 0.0,
+            "pages": 0, "dedup_pages": 0})
+        dur = e.get("dur", 0) / 1e3
+        d["handoff_ms"] += dur
+        key = f"{e['name']}_ms"
+        if key in d:
+            d[key] += dur
+        a = e.get("args") or {}
+        if e["name"] == "install":
+            d["pages"] += int(a.get("pages", 0))
+            d["dedup_pages"] += int(a.get("dedup_pages", 0))
+    for d in handoff_by_req.values():
+        for key in ("handoff_ms", "gather_ms", "install_ms"):
+            d[key] = round(d[key], 3)
+
     requests = []
     for e in spans:
         if e["name"] != "request":
@@ -153,6 +183,10 @@ def analyze(doc: dict) -> dict:
         if spec is not None:
             row["speculative"] = spec
             row["accept_rate"] = spec["accept_rate"]
+        ho = handoff_by_req.get(args.get("id"))
+        if ho is not None:
+            row["handoff"] = ho
+            row["handoff_ms"] = ho["handoff_ms"]
         requests.append(row)
     requests.sort(key=lambda r: (r["req"] is None, r["req"]))
 
@@ -249,16 +283,20 @@ def main(argv: list[str] | None = None) -> int:
     if report["requests"]:
         print("\nPer-request rollup (ms):")
         spec_any = any("speculative" in r for r in report["requests"])
+        ho_any = any("handoff" in r for r in report["requests"])
         rows = [
             {**{k: r[k] for k in ("req", "status", "bucket", "total_ms",
                                   "other_ms")},
              "phases": " ".join(f"{k}={v}" for k, v in r["phases_ms"].items()),
-             **({"accept_rate": r.get("accept_rate")} if spec_any else {})}
+             **({"accept_rate": r.get("accept_rate")} if spec_any else {}),
+             **({"handoff_ms": r.get("handoff_ms")} if ho_any else {})}
             for r in report["requests"]
         ]
         cols = ["req", "status", "bucket", "total_ms", "phases", "other_ms"]
         if spec_any:
             cols.append("accept_rate")
+        if ho_any:
+            cols.append("handoff_ms")
         print(_fmt_table(rows, cols))
     if report["instants"]:
         print("\nInstant events:")

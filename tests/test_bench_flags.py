@@ -45,6 +45,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_SAMPLING": ["sampling"],
     "DTM_BENCH_SKIP_CHUNKED": ["chunked_prefill"],
     "DTM_BENCH_SKIP_SLO_DAEMON": ["slo_daemon"],
+    "DTM_BENCH_SKIP_DISAGG": ["disagg"],
 }
 
 

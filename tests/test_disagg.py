@@ -1,0 +1,267 @@
+"""Disaggregated prefill/decode serving (ISSUE 16).
+
+The decisive properties of the role-typed tier:
+
+* PARITY — a prefill(2)+decode tier produces token-identical greedy
+  output to one monolithic paged engine: packaging a prefill into a
+  :class:`HandoffPacket`, installing it page-by-page on the decode
+  replica, and picking the first token from the handed-off logits row is
+  invisible in the tokens.
+* ROLE SEPARATION — prefill replicas generate ZERO tokens (the pick
+  runs decode-side), decode replicas run ZERO prefill programs
+  (``prewarm()["by_site"]`` pins the per-role program family), and a
+  decode-role engine refuses direct submissions outright.
+* EXACTLY-ONCE — a ``kv-handoff`` chaos hit releases the packet's hold
+  and re-dispatches through a fresh prefill; a DOUBLE failover (a
+  prefill replica dies with queued work, then a decode replica dies with
+  occupied slots) still retires every request ``done`` with identical
+  tokens, each streamed token delivered exactly once across attempts
+  (the delivered high-water mark suppresses replayed prefixes).
+* ROLLUP — ``ServingStats`` records carry their engine's ``role``, the
+  router rollup groups ``per_role`` sub-rollups (decode owns the
+  user-visible percentiles, prefill owns work that never retires
+  locally), and everything stays strict-JSON; ``cat="handoff"`` spans
+  roll up into trace_report's per-request ``handoff_ms`` column.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    FIFOScheduler,
+    InferenceEngine,
+    Router,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+)
+
+KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6], [9, 1], [3, 3, 3, 3]]
+
+
+def _model_and_params(seed=0):
+    model = get_model("causal_lm", **KW)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _factory(model, params, roles, slots=2, **kw):
+    def make_engine(tid, index):
+        return InferenceEngine(
+            model, params, slots=slots, max_len=16, kv_page_size=4,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=16),
+            trace_tid=tid, role=roles[index], **kw)
+    return make_engine
+
+
+def _reference(model, params, prompts=PROMPTS, max_new=6):
+    eng = InferenceEngine(model, params, slots=2, max_len=16,
+                          kv_page_size=4,
+                          scheduler=FIFOScheduler(max_len=16, buckets=(8,)))
+    reqs = [eng.submit(p, max_new=max_new) for p in prompts]
+    eng.run()
+    eng.close()
+    return [list(r.generated) for r in reqs]
+
+
+# ----------------------------------------------------------------------
+# parity + role separation
+
+
+def test_disagg_parity_and_role_separation():
+    """prefill+decode tier == one monolithic paged engine, token for
+    token; every request hands off exactly once; the per-role rollup
+    shows zero tokens generated prefill-side."""
+    model, params = _model_and_params()
+    want = _reference(model, params)
+    roles = ["prefill", "decode"]
+    r = Router(_factory(model, params, roles), 2, roles=roles)
+    rrs = [r.submit(p, max_new=6) for p in PROMPTS]
+    r.run_until_done(max_steps=500)
+    assert [list(rr.generated) for rr in rrs] == want
+    assert all(rr.status == "done" for rr in rrs)
+    assert r.handoffs == len(PROMPTS)
+    assert r.handoff_faults == 0
+    summ = r.summary()
+    # strict JSON (None, never NaN) all the way down
+    json.dumps(summ, allow_nan=False)
+    per_role = summ["per_role"]
+    assert set(per_role) == {"prefill", "decode"}
+    assert per_role["prefill"]["tokens_generated"] == 0
+    assert per_role["decode"]["tokens_generated"] == sum(
+        len(t) for t in want)
+    # per-engine records carry their role
+    roles_seen = {rec["role"] for rec in summ["per_engine"]}
+    assert roles_seen == {"prefill", "decode"}
+    r.close()
+
+
+def test_per_role_prewarm_census():
+    """The per-role program family: a decode replica compiles ZERO
+    prefill/extend/insert programs, a prefill replica ZERO pick/window
+    programs — the disaggregation claim the compile census pins.  A
+    UNIQUE model width keeps this test's compiles out of the process
+    jit cache other tests warm (``by_site`` reports compile DELTAS)."""
+    model = get_model("causal_lm", **{**KW, "dim": 48, "num_classes": 17})
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    roles = ["prefill", "decode"]
+    r = Router(_factory(model, params, roles), 2, roles=roles)
+    warm = r.prewarm()
+    pre = set(warm["replicas"][0]["by_site"])
+    dec = set(warm["replicas"][1]["by_site"])
+    assert not any(s.startswith(("first_pick", "decode_window[",
+                                 "verify_window[")) for s in pre)
+    assert any(s.startswith("prefill[") for s in pre)
+    assert "handoff_gather" in pre
+    assert not any(s.startswith(("prefill[", "extend[", "slot_insert"))
+                   for s in dec)
+    assert any(s.startswith("decode_window[") for s in dec)
+    assert "first_pick" in dec and "handoff_install" in dec
+    r.close()
+
+
+def test_role_validation_and_decode_submit_refusal():
+    model, params = _model_and_params()
+    # a decode-role engine takes no direct submissions
+    eng = InferenceEngine(
+        model, params, slots=2, max_len=16, kv_page_size=4,
+        scheduler=FIFOScheduler(max_len=16, buckets=(8,)), role="decode")
+    with pytest.raises(RuntimeError, match="decode-role"):
+        eng.submit([1, 2], max_new=4)
+    eng.close()
+    # disaggregated roles require the paged cache
+    with pytest.raises(ValueError, match="kv_page_size"):
+        InferenceEngine(model, params, slots=2, max_len=16,
+                        scheduler=FIFOScheduler(max_len=16, buckets=(8,)),
+                        role="prefill")
+    # a tier needs both prefill and decode capacity
+    roles = ["decode", "decode"]
+    with pytest.raises(ValueError, match="prefill"):
+        Router(_factory(model, params, roles), 2, roles=roles)
+    # roles list must match the replica count
+    with pytest.raises(ValueError, match="roles"):
+        Router(_factory(model, params, ["prefill", "decode"]), 2,
+               roles=["prefill"])
+
+
+# ----------------------------------------------------------------------
+# chaos + double failover, exactly-once
+
+
+def test_kv_handoff_chaos_releases_and_redispatches_exactly_once():
+    """A ``kv-handoff`` chaos hit drops the packet in flight: the router
+    releases the hold, re-dispatches through a fresh prefill, and the
+    wave still finishes token-identical with exactly-once streams."""
+    model, params = _model_and_params()
+    want = _reference(model, params)
+    inj = FaultInjector(FaultPlan(seed=1, faults=(
+        FaultSpec(site="kv-handoff", at=(0,)),)))
+    streams: dict[int, list[int]] = {}
+    roles = ["prefill", "decode"]
+    r = Router(_factory(model, params, roles), 2, roles=roles, chaos=inj)
+    rrs = [r.submit(p, max_new=6,
+                    callback=lambda rr, tok: streams.setdefault(
+                        rr.id, []).append(int(tok)))
+           for p in PROMPTS]
+    r.run_until_done(max_steps=500)
+    assert [list(rr.generated) for rr in rrs] == want
+    assert all(rr.status == "done" for rr in rrs)
+    assert r.handoff_faults == 1
+    assert sum(rr.redispatches for rr in rrs) == 1
+    for rr in rrs:
+        assert streams.get(rr.id, []) == list(rr.generated)
+    r.close()
+
+
+def test_double_failover_prefill_then_decode_exactly_once():
+    """A prefill replica dies with queued admissions, then a decode
+    replica dies with occupied slots: both casualties re-dispatch (full
+    re-prefill, fresh handoff), every request retires ``done`` with
+    identical tokens, and the delivered high-water mark keeps each
+    stream exactly-once across all attempts."""
+    model, params = _model_and_params()
+    want = _reference(model, params)
+    roles = ["prefill", "prefill", "decode", "decode"]
+    streams: dict[int, list[int]] = {}
+    r = Router(_factory(model, params, roles), 4, roles=roles)
+    rrs = [r.submit(p, max_new=6,
+                    callback=lambda rr, tok: streams.setdefault(
+                        rr.id, []).append(int(tok)))
+           for p in PROMPTS]
+    # kill a prefill replica while its queue holds admissions
+    dead_p = next(rep for rep in r.replicas
+                  if rep.role == "prefill" and len(rep.engine.scheduler))
+    r._fail_replica(dead_p, RuntimeError("induced prefill kill"))
+    r.step()
+    # now kill a decode replica holding live decodes
+    dead_d = next(rep for rep in r.replicas
+                  if rep.role == "decode" and rep.alive
+                  and rep.engine.occupied)
+    r._fail_replica(dead_d, RuntimeError("induced decode kill"))
+    r.run_until_done(max_steps=500)
+    assert [list(rr.generated) for rr in rrs] == want
+    assert all(rr.status == "done" for rr in rrs)
+    assert r.failovers == 2
+    assert sum(rr.redispatches for rr in rrs) >= 2
+    for rr in rrs:
+        assert streams.get(rr.id, []) == list(rr.generated)
+    summ = r.summary()
+    assert summ["replicas_failed"] == 2 and summ["failovers"] == 2
+    assert summ["n_engine_fault"] >= 2
+    json.dumps(summ, allow_nan=False)
+    r.close()
+
+
+# ----------------------------------------------------------------------
+# tracing rollup
+
+
+def test_handoff_trace_rollup(tmp_path):
+    """Handoff gather/install land ``cat="handoff"`` spans; the exported
+    trace validates and trace_report rolls them up into per-request
+    ``handoff_ms`` with page counts."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        Tracer,
+        validate_trace,
+    )
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import trace_report
+
+    model, params = _model_and_params()
+    tracer = Tracer()
+    roles = ["prefill", "decode"]
+    r = Router(_factory(model, params, roles, tracer=tracer), 2,
+               roles=roles, tracer=tracer)
+    rrs = [r.submit(p, max_new=4) for p in PROMPTS[:3]]
+    r.run_until_done(max_steps=500)
+    assert all(rr.status == "done" for rr in rrs)
+    r.close()
+    path = tmp_path / "trace.json"
+    tracer.export_trace(str(path))
+    assert validate_trace(str(path)) == []
+
+    report = trace_report.analyze(json.loads(path.read_text()))
+    names = {row["phase"] for row in report["phases"]}
+    assert {"handoff/gather", "handoff/install"} <= names
+    rolled = [row for row in report["requests"] if "handoff" in row]
+    assert rolled, "no request rolled up handoff spans"
+    assert any(row["handoff"]["pages"] > 0 for row in rolled)
+    for row in rolled:
+        assert row["handoff_ms"] >= 0.0
+        assert row["handoff"]["dedup_pages"] <= row["handoff"]["pages"]

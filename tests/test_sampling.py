@@ -56,7 +56,7 @@ from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import CompileTracker
 
 KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
 
-PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6]]
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6], [9, 1, 7]]
 
 # chi-squared 99.9th-percentile critical values by dof (no scipy in the
 # image; a fixed table keeps the gate dependency-free)
@@ -189,6 +189,63 @@ def test_top_k_one_is_argmax_and_vocab_k_is_noop():
     assert base == full
 
 
+def test_min_p_validation():
+    with pytest.raises(ValueError, match="min_p"):
+        SamplingParams(temperature=1.0, min_p=-0.1)
+    with pytest.raises(ValueError, match="min_p"):
+        SamplingParams(temperature=1.0, min_p=1.5)
+    with pytest.raises(ValueError, match="min_p"):
+        SamplingParams(temperature=1.0, min_p=float("nan"))
+    # min_p filters a sampling distribution: meaningless at temperature 0
+    with pytest.raises(ValueError, match="temperature > 0"):
+        SamplingParams(temperature=0.0, min_p=0.5)
+    assert SamplingParams(temperature=1.0, min_p=0.25).min_p == 0.25
+
+
+def test_filter_minp_rows_per_row_support():
+    """The data-plane min-p filter (ISSUE 16 satellite): each ROW cuts
+    tokens whose probability is below its own ``min_p * max_prob`` —
+    the threshold scales with the row's confidence; min_p=0 is a per-row
+    no-op and min_p=1 keeps only the argmax, all in one (B, V) program."""
+    from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
+        _filter_minp_rows,
+    )
+    rng = np.random.default_rng(1)
+    raw = rng.normal(size=(3, 16)).astype(np.float32)  # no ties w.h.p.
+    mps = jnp.asarray([0.0, 0.5, 1.0], jnp.float32)
+    out = np.asarray(_filter_minp_rows(jnp.asarray(raw), mps))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_array_equal(out[0], raw[0])      # 0 = filter off
+    probs = np.exp(raw[1]) / np.exp(raw[1]).sum()
+    keep = probs >= 0.5 * probs.max()
+    np.testing.assert_array_equal(out[1][keep], raw[1][keep])
+    assert (out[1][~keep] == neg).all()
+    top = np.argmax(raw[2])                            # 1 = argmax only
+    assert out[2][top] == raw[2][top]
+    mask = np.ones(16, bool)
+    mask[top] = False
+    assert (out[2][mask] == neg).all()
+
+
+def test_min_p_one_is_argmax_and_zero_is_noop():
+    """``min_p=1.0`` at ANY temperature keeps only the argmax — token-
+    identical to the greedy engine (seed inert in effect); ``min_p=0``
+    leaves the distribution untouched — stream-identical to the same
+    seed without the filter.  Same compiled window as every other row."""
+    model, params = _model_and_params(seed=9)
+    want, _ = _serve(model, params)                   # greedy reference
+    got, _ = _serve(model, params,
+                    sampling=SamplingParams(temperature=1.5, min_p=1.0,
+                                            seed=77))
+    assert got == want
+    base, _ = _serve(model, params,
+                     sampling=SamplingParams(temperature=0.9, seed=5))
+    off, _ = _serve(model, params,
+                    sampling=SamplingParams(temperature=0.9, min_p=0.0,
+                                            seed=5))
+    assert base == off
+
+
 def test_scheduler_submit_rejects_non_params():
     sched = FIFOScheduler(max_len=32, buckets=(8,))
     with pytest.raises(ValueError, match="SamplingParams"):
@@ -282,7 +339,8 @@ def test_zero_new_programs_across_sampling_configs():
     model, params = _model_and_params(seed=5)
     mixes = [None, SamplingParams(temperature=0.7, top_p=0.9, seed=1),
              SamplingParams(temperature=1.3, top_k=4, seed=9),
-             SamplingParams(temperature=0.4, top_p=0.3, top_k=7, seed=42)]
+             SamplingParams(temperature=0.4, top_p=0.3, top_k=7, seed=42),
+             SamplingParams(temperature=0.9, min_p=0.2, seed=17)]
     for kw in ({"decode_ahead": 4},
                {"speculative": "ngram", "draft_len": 3}):
         eng = _engine(model, params, **kw)
@@ -344,7 +402,8 @@ def test_verify_rejection_sampling_matches_target_distribution():
         topps = jnp.full((B,), topp, jnp.float32)
         p = np.asarray(jax.nn.softmax(
             _tempered_rows(logits0[:1], temps[:1], topps[:1],
-                           jnp.zeros((1,), jnp.int32))))[0]
+                           jnp.zeros((1,), jnp.int32),
+                           jnp.zeros((1,), jnp.float32))))[0]
         draft = int(np.argmax(p) if pick == "hi" else np.argmin(p))
         chunk = np.zeros((B, 2), np.int32)
         chunk[:, 0] = np.asarray(pend)
@@ -356,7 +415,8 @@ def test_verify_rejection_sampling_matches_target_distribution():
             _, toks, logps, acc, _ = verify(
                 params, cache0, jnp.asarray(chunk),
                 jnp.ones((B,), jnp.int32), jnp.ones((B,), bool),
-                temps, topps, jnp.zeros((B,), jnp.int32), keys,
+                temps, topps, jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.float32), keys,
                 jnp.zeros((B,), jnp.int32))
             np.add.at(counts, np.asarray(toks)[:, 0], 1)
         assert counts.sum() == B * reps >= 10_000
