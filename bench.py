@@ -58,11 +58,16 @@ stream saturates the prefill replica, token parity vs the monolithic
 tier on the full mixed stream, a kv-handoff chaos leg gating
 exactly-once streams, and the per-role compile census (decode replicas
 compile zero prefill programs and vice versa) —
-scripts/bench_disagg.py, skip with DTM_BENCH_SKIP_DISAGG).  The
-tp_serving, train_census, quant, sampling, slo_daemon, disagg, and
-serving-subprocess gates (compile census budgets, the ISSUE 11
-telemetry <=2% overhead bar, SLO/goodput counter arithmetic) fail the
-bench run (exit 3) on breach, after the record prints.
+scripts/bench_disagg.py, skip with DTM_BENCH_SKIP_DISAGG), and a
+``frontdoor`` block (ISSUE 17: the asyncio HTTP/SSE front door over the
+daemonized tier — unary/SSE/direct-stream token parity, pump chaos
+behind live HTTP clients with zero drops and exactly-once streams, and
+admission backpressure surfacing machine-readable Retry-After hints —
+scripts/bench_frontdoor.py, skip with DTM_BENCH_SKIP_FRONTDOOR).  The
+tp_serving, train_census, quant, sampling, slo_daemon, disagg,
+frontdoor, and serving-subprocess gates (compile census budgets, the
+ISSUE 11 telemetry <=2% overhead bar, SLO/goodput counter arithmetic)
+fail the bench run (exit 3) on breach, after the record prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -843,6 +848,50 @@ def main() -> None:
             disagg_gate_rc = 1
             print(f"bench: disagg phase failed: {e!r}", file=sys.stderr)
 
+    # internet-shaped front door (ISSUE 17): the asyncio protocol server
+    # over the daemonized tier — HTTP/SSE parity with direct daemon
+    # streams, pump chaos behind live HTTP clients (zero drops,
+    # exactly-once), and admission backpressure surfacing machine-
+    # readable Retry-After hints end-to-end.  A breach FAILS the bench
+    # run (exit 3) after the record prints.  Runs
+    # scripts/bench_frontdoor.py in a SUBPROCESS on the CPU backend.
+    # Skippable (DTM_BENCH_SKIP_FRONTDOOR).
+    frontdoor = None
+    frontdoor_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_FRONTDOOR"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_frontdoor.py")],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "frontdoor":
+                    frontdoor = rec
+            if frontdoor is None or out.returncode != 0:
+                frontdoor_gate_rc = out.returncode or 1
+                print(
+                    f"bench: frontdoor subprocess "
+                    f"{'produced no record' if frontdoor is None else 'FAILED (parity/chaos/backpressure gate breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            frontdoor_gate_rc = 1
+            print(f"bench: frontdoor phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -958,6 +1007,10 @@ def main() -> None:
         result["disagg"] = {
             k: v for k, v in disagg.items() if k != "metric"
         }
+    if frontdoor is not None:
+        result["frontdoor"] = {
+            k: v for k, v in frontdoor.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -972,7 +1025,7 @@ def main() -> None:
     # prints so the numbers are never lost with the verdict
     if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
             or sampling_gate_rc or chunked_gate_rc or slo_gate_rc
-            or disagg_gate_rc):
+            or disagg_gate_rc or frontdoor_gate_rc):
         import sys
 
         sys.exit(3)

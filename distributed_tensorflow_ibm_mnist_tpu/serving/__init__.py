@@ -59,6 +59,19 @@ Mesh-TensorFlow separation of device program from execution driver
   (:class:`~.policies.FIFOPolicy`, :class:`~.policies.PriorityPolicy`,
   :class:`~.policies.DeadlineAwarePolicy` raising
   :class:`~.policies.SLOUnmeetable`)
+* the internet-shaped front door (ISSUE 17): :class:`~.frontend.
+  FrontDoor` — an asyncio HTTP/1.1 + SSE protocol server over the daemon
+  (``POST /v1/generate`` streaming or unary, ``GET /healthz``,
+  ``GET /metrics``; disconnect cancels, 429/503 carry policy
+  ``Retry-After`` hints) with :class:`~.frontend.FrontDoorClient` as the
+  stdlib wire client; :class:`~.traces.ArrivalTrace` /
+  :class:`~.traces.TraceEvent` — recorded arrival traces (bursty /
+  diurnal / heavy-tail / Poisson generators, JSONL round-trip,
+  per-class interactive-vs-batch goodput via
+  :func:`~.traces.replay_trace`); :class:`~.autoscaler.Autoscaler` —
+  telemetry-driven elastic capacity (warm scale-up through the compile
+  cache + ``WeightWatcher`` stamping, drain-before-retire scale-down
+  with zero drops)
 
 Observability (ISSUE 6): pass ``tracer=`` (utils/tracing.Tracer) to the
 engine and every request records a span tree (submit → queue → admit/
@@ -85,10 +98,15 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.daemon import (
     DaemonRequest,
     ServingDaemon,
 )
+from distributed_tensorflow_ibm_mnist_tpu.serving.autoscaler import Autoscaler
 from distributed_tensorflow_ibm_mnist_tpu.serving.drafter import NgramDrafter
 from distributed_tensorflow_ibm_mnist_tpu.serving.engine import (
     EngineStalled,
     InferenceEngine,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving.frontend import (
+    FrontDoor,
+    FrontDoorClient,
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
     KVPagePool,
@@ -121,13 +139,28 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.stats import (
     ServingStats,
     slo_verdict,
 )
+from distributed_tensorflow_ibm_mnist_tpu.serving.traces import (
+    ArrivalTrace,
+    TraceEvent,
+    bursty_trace,
+    diurnal_trace,
+    heavy_tail_trace,
+    per_class_report,
+    poisson_trace,
+    replay_trace,
+    with_slos,
+)
 
 __all__ = [
     "AdmissionPolicy",
+    "ArrivalTrace",
+    "Autoscaler",
     "DaemonRequest",
     "DeadlineAwarePolicy",
     "EngineStalled",
     "FIFOPolicy",
+    "FrontDoor",
+    "FrontDoorClient",
     "InferenceEngine",
     "FIFOScheduler",
     "KVPagePool",
@@ -145,8 +178,16 @@ __all__ = [
     "SamplingParams",
     "ServingDaemon",
     "ServingStats",
+    "TraceEvent",
     "WeightWatcher",
+    "bursty_trace",
+    "diurnal_trace",
+    "heavy_tail_trace",
     "init_paged_cache",
     "pages_needed",
+    "per_class_report",
+    "poisson_trace",
+    "replay_trace",
     "slo_verdict",
+    "with_slos",
 ]

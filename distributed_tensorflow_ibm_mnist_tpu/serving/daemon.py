@@ -98,6 +98,7 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.policies import (
     FIFOPolicy,
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.replica import (
+    DRAINING,
     FAILED,
     HEALTHY,
 )
@@ -228,7 +229,8 @@ class ServingDaemon:
         self._delivery_q: queue.Queue = queue.Queue()
         self._ids = 0
         self._counts_lock = threading.Lock()
-        self.counters = {"submitted": 0, "rejected": 0, "done": 0,
+        self.counters = {"submitted": 0, "rejected": 0,
+                         "rejected_with_hint": 0, "done": 0,
                          "cancelled": 0, "failed": 0,
                          "delivered_tokens": 0, "callback_errors": 0,
                          "pump_faults": 0, "pump_wedges": 0}
@@ -243,6 +245,22 @@ class ServingDaemon:
         with self._counts_lock:
             self.counters[name] += n
 
+    def _reject(self, exc: QueueFull, queued: int) -> None:
+        """Stamp the policy's backoff hint onto a rejection about to be
+        raised and keep the books: ``rejected`` counts every rejection,
+        ``rejected_with_hint`` the subset that carried a machine-readable
+        estimate (the 429/503 Retry-After source).  Called under the
+        admission lock — the depth the hint is computed at is exactly the
+        depth the verdict was made at."""
+        if getattr(exc, "retry_after_s", None) is None:
+            try:
+                exc.retry_after_s = self.policy.retry_after_s(queued)
+            except Exception:
+                exc.retry_after_s = None   # a sick policy never blocks a 429
+        self._count("rejected")
+        if exc.retry_after_s is not None:
+            self._count("rejected_with_hint")
+
     # ------------------------------------------------------------------
     # caller API
 
@@ -253,7 +271,10 @@ class ServingDaemon:
                sampling=None) -> DaemonRequest:
         """Thread-safe admission.  Raises :class:`QueueFull` at the
         admission bound, :class:`~.policies.SLOUnmeetable` when the
-        policy sheds, ``RuntimeError`` after drain/close.  ``callback``
+        policy sheds, ``RuntimeError`` after drain/close.  Every raised
+        rejection carries ``retry_after_s`` — the policy's wait-predictor
+        backoff hint (None when it has no basis), the machine-readable
+        half of a 429/503 ``Retry-After`` header (ISSUE 17).  ``callback``
         (``cb(dr, tok)``) runs on the delivery thread, in stream order."""
         if self._closed or self._draining:
             raise RuntimeError(
@@ -264,10 +285,11 @@ class ServingDaemon:
             # so concurrent submitters cannot oversubscribe the bound
             queued = len(self._admission) + len(self._inflight)
             if queued >= self.max_queue:
-                self._count("rejected")
-                raise QueueFull(
+                exc = QueueFull(
                     f"daemon admission queue at bound ({self.max_queue}) "
                     "— retry later or shed load")
+                self._reject(exc, queued)
+                raise exc
             try:
                 dr_id = self._ids
                 dr = DaemonRequest(dr_id, prompt, max_new,
@@ -277,8 +299,8 @@ class ServingDaemon:
                                    ttft_slo_s=ttft_slo_s,
                                    tpot_slo_s=tpot_slo_s, sampling=sampling)
                 self.policy.admit(dr, queued)
-            except QueueFull:
-                self._count("rejected")
+            except QueueFull as exc:
+                self._reject(exc, queued)
                 raise
             self._ids += 1
             heapq.heappush(self._admission, (self.policy.key(dr), dr))
@@ -298,6 +320,43 @@ class ServingDaemon:
             else:
                 return
 
+    def cancel(self, dr: DaemonRequest,
+               reason: str = "cancelled by caller") -> bool:
+        """Cancel one request wherever it currently is (ISSUE 17 — the
+        front door's client-disconnect path).  Returns False when ``dr``
+        is already terminal, True when cancellation was initiated.
+
+        Still waiting in admission: removed from the heap and ended
+        ``cancelled`` immediately (it holds nothing).  Already in the
+        tier: :meth:`Router.cancel` forces its deadline clocks into the
+        past under the tier lock, so the next pump sweep retires it down
+        the lapsed-deadline path — slot freed, KV pages freed, tracer
+        span closed — and :meth:`_scan_completions` delivers the
+        terminal event.  Conservation stays exact: the request counts
+        ``cancelled``, never dropped."""
+        if dr.done:
+            return False
+        # force the daemon-level clock first: whatever in-between state
+        # the dispatcher has the request in (popped but not dispatched,
+        # requeued after transient backpressure), its next overdue check
+        # cancels it — there is no unguarded window
+        dr.deadline_s = -1e18
+        removed = False
+        with self._adm_cv:
+            for i, (_key, queued_dr) in enumerate(self._admission):
+                if queued_dr is dr:
+                    del self._admission[i]
+                    heapq.heapify(self._admission)
+                    removed = True
+                    break
+        if removed:
+            self._end_request(dr, "cancelled", reason)
+            return True
+        with self._tier_lock:
+            if dr.rr is not None and not dr.rr.done:
+                self.router.cancel(dr.rr, reason=reason)
+        return True
+
     @property
     def outstanding(self) -> int:
         with self._adm_cv:
@@ -312,6 +371,71 @@ class ServingDaemon:
         c["conserved"] = (c["submitted"] == c["done"] + c["cancelled"]
                           + c["failed"] + c["outstanding"])
         return c
+
+    def summary(self) -> dict:
+        """The service-level rollup: the router's cluster ``ServingStats``
+        merge + router counters, with the daemon's front-door books
+        (submitted/rejected/``rejected_with_hint``/conservation) folded in
+        under ``"daemon"`` — rejections never reach engine stats (they
+        never entered the tier), so this is where they surface."""
+        out = self.router.summary()
+        out["daemon"] = self.conservation()
+        return out
+
+    # ------------------------------------------------------------------
+    # elastic capacity (ISSUE 17): the autoscaler's seam.  All three are
+    # thread-safe; scale-ups become dispatchable the moment they return.
+
+    def add_replica(self, role: str = "both"):
+        """Scale-up: append one fresh replica (warm when the factory
+        wires a persistent compile cache), give it an engine lock, and
+        start its pump thread.  Returns the new
+        :class:`~.replica.Replica`."""
+        if self._closed:
+            raise RuntimeError("daemon is closed")
+        with self._tier_lock:
+            rep = self.router.add_replica(role=role)
+            self._engine_locks.setdefault(rep.index, threading.Lock())
+        self._ensure_pump(rep)
+        return rep
+
+    def restart_replica(self, index: int) -> float:
+        """Scale-up, warm path: respawn a retired (or failed) replica in
+        place through :meth:`Router.restart` — the compile cache makes the
+        bring-up a cache read, which is what bounds the scale-up TTFT
+        penalty — and start a fresh pump for it.  Returns the measured
+        bring-up seconds (the autoscaler's TTFT-penalty bound)."""
+        if self._closed:
+            raise RuntimeError("daemon is closed")
+        with self._tier_lock:
+            spawn_s = self.router.restart(index)
+            rep = self.router.replicas[index]
+            self._engine_locks.setdefault(rep.index, threading.Lock())
+        self._ensure_pump(rep)
+        return spawn_s
+
+    def retire_replica(self, index: int) -> bool:
+        """Scale-down, zero-drop: begin the drain (no new dispatches; the
+        pump keeps serving what is in flight).  The watchdog closes the
+        replica once idle (:meth:`Router.finish_retires`) and its pump
+        exits.  False when the router refuses (replica not HEALTHY, or
+        it is the last prefill/decode-capable capacity)."""
+        with self._tier_lock:
+            return self.router.begin_retire(index)
+
+    def _ensure_pump(self, rep) -> None:
+        """Start a pump thread for ``rep`` unless a live one exists.
+        Before :meth:`start` this is a no-op — start() pumps every
+        replica then in ``router.replicas``, scale-ups included."""
+        if not self._started or self._stop.is_set():
+            return
+        name = f"dtm-pump-{rep.index}"
+        if any(t.name == name and t.is_alive() for t in self._threads):
+            return
+        t = threading.Thread(target=self._pump, args=(rep,),
+                             name=name, daemon=True)
+        self._threads.append(t)
+        t.start()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -430,6 +554,12 @@ class ServingDaemon:
                     return
             try:
                 with self._engine_locks[rep.index]:
+                    # re-check under the engine lock: finish_retires()
+                    # closes a drained replica's engine under this same
+                    # lock, so a pump that raced the idle check must see
+                    # the terminal state here, never step a closed engine
+                    if rep.state == FAILED or not rep.alive:
+                        return
                     rep.engine.step()
             except Exception as e:
                 self._fail_from_pump(rep, e)
@@ -592,6 +722,11 @@ class ServingDaemon:
                     self.router._pump_handoffs()
                 except Exception:
                     pass   # a sick handoff pump must not kill the watchdog
+                if self.router._retiring:
+                    try:
+                        self.router.finish_retires()
+                    except Exception:
+                        pass
                 if self.router._orphans:
                     try:
                         self.router._retry_orphans()
@@ -612,7 +747,13 @@ class ServingDaemon:
         failed over even though its pump never returns."""
         now = self.clock()
         for rep in self.router.replicas:
-            if rep.state != HEALTHY or not rep.alive:
+            # retiring drains are watched too: a replica that wedges with
+            # work mid-retire would stall the scale-down forever — failing
+            # it over instead harvests its in-flight work (still zero-drop)
+            watched = (rep.state == HEALTHY
+                       or (rep.state == DRAINING
+                           and rep.index in self.router._retiring))
+            if not watched or not rep.alive:
                 self._work_since.pop(rep.index, None)
                 continue
             if not rep.engine.has_work:
@@ -630,7 +771,7 @@ class ServingDaemon:
                     frozen_s=round(now - last, 6))
             self._work_since.pop(rep.index, None)
             with self._tier_lock:
-                if rep.state == HEALTHY:
+                if rep.state in (HEALTHY, DRAINING):
                     try:
                         self.router._fail_replica(rep, RuntimeError(
                             f"pump wedged: no progress for "

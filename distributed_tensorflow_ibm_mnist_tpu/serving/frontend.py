@@ -1,0 +1,662 @@
+"""The internet-shaped front door: an asyncio protocol server over the
+daemonized serving tier (ISSUE 17).
+
+Everything below the network edge already behaves like a service —
+:class:`~.daemon.ServingDaemon` is long-lived, thread-safe, policy-
+admitted, chaos-proven — but its callers are in-process Python.  This
+module is the protocol layer that turns the library into a SERVICE
+(TensorFlow's own library→serving move, PAPERS.md 1605.08695), built the
+TF-Replicator way (1902.00465): the user-facing API is a stable wire
+schema, and the execution tier under it can change shape — replicas
+failing over, weights hot-swapping, the autoscaler breathing — without
+the client ever seeing anything but tokens.
+
+Endpoints (HTTP/1.1, stdlib ``asyncio.start_server`` — no new deps):
+
+* ``POST /v1/generate`` — JSON in (prompt token ids, ``max_new``,
+  optional per-request ``sampling``/``priority``/``deadline_s``/SLOs);
+  JSON out, or an SSE token stream when ``"stream": true`` (one
+  ``data: {"token": t}`` event per token, a terminal ``event: end`` with
+  the final status).  Tokens cross from the daemon's delivery thread
+  into asyncio via ``loop.call_soon_threadsafe`` — the thread-world →
+  event-loop bridge — so SSE order is exactly delivery order and the
+  stream inherits the tier's exactly-once guarantee across failover.
+* ``GET /healthz`` — replica census (every replica's vitals, dead or
+  alive) + the daemon's exact-conservation check; 503 when no healthy
+  replica remains.
+* ``GET /metrics`` — the existing :class:`~..utils.telemetry.
+  MetricsRegistry` Prometheus exposition, snapshotted atomically (the
+  registry's own lock) — the front door adds its counters to the SAME
+  registry, so one scrape sees the whole tier.
+
+Backpressure maps to status codes instead of buffering: the daemon's
+:class:`~.scheduler.QueueFull` becomes **429** and
+:class:`~.policies.SLOUnmeetable` (plus a draining/dead tier) becomes
+**503**, each carrying ``Retry-After`` from the admission policy's wait
+predictor when it has one (``exc.retry_after_s`` — ISSUE 17 satellite).
+The accept side is bounded too (``max_connections``): past the bound a
+connection gets an immediate 503, never an unbounded accept queue.
+
+Client disconnect mid-stream CANCELS the underlying request: the handler
+watches the socket for EOF while it streams, and a hangup calls
+:meth:`~.daemon.ServingDaemon.cancel` — the slot frees, the KV pages
+free, the tracer span closes, and conservation counts it ``cancelled``
+(pinned in tests/test_frontend.py).  A disconnected client costs the
+tier at most one pump sweep, not a slot leaked until deadline.
+
+Thread model: the server runs on ONE asyncio event loop (optionally on
+its own thread via :meth:`FrontDoor.start_in_thread` — the test/bench
+harness path).  Handler coroutines touch the daemon only through its
+thread-safe surface (``submit``/``cancel``/``conservation``); daemon
+threads touch asyncio only through ``call_soon_threadsafe``.  The
+frontend's own counters are loop-thread-only ints mirrored into the
+registry.
+
+:class:`FrontDoorClient` is the curl-equivalent blocking client
+(stdlib ``http.client``) the example, tests, and bench drive the wire
+with — including an SSE parser, so parity checks compare the actual
+bytes on the wire against :meth:`ServingDaemon.stream`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import math
+import threading
+from typing import Callable, Iterator
+
+from distributed_tensorflow_ibm_mnist_tpu.serving.policies import SLOUnmeetable
+from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import SamplingParams
+from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import QueueFull
+
+_MAX_BODY = 1 << 20          # 1 MiB request-body bound (413 past it)
+_MAX_HEAD = 32 << 10         # request line + headers bound
+_SAMPLING_KEYS = ("temperature", "top_p", "top_k", "min_p", "seed")
+
+
+class _BadRequest(ValueError):
+    """Maps to a 400 with the message in the JSON error body."""
+
+
+def _parse_generate(payload: dict) -> dict:
+    """Validate the ``/v1/generate`` body into ``ServingDaemon.submit``
+    kwargs.  Every verdict is a :class:`_BadRequest` naming the field —
+    a malformed request costs the client a 400, never the tier a slot."""
+    if not isinstance(payload, dict):
+        raise _BadRequest("body must be a JSON object")
+    prompt = payload.get("prompt")
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       for t in prompt)):
+        raise _BadRequest("'prompt' must be a non-empty list of token ids")
+    max_new = payload.get("max_new")
+    if not isinstance(max_new, int) or isinstance(max_new, bool) or max_new < 1:
+        raise _BadRequest("'max_new' must be an int >= 1")
+    out = {"prompt": prompt, "max_new": max_new,
+           "stream": bool(payload.get("stream", False))}
+    priority = payload.get("priority", 0)
+    if not isinstance(priority, int) or isinstance(priority, bool):
+        raise _BadRequest("'priority' must be an int")
+    out["priority"] = priority
+    for key in ("deadline_s", "ttft_slo_s", "tpot_slo_s"):
+        val = payload.get(key)
+        if val is not None:
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or not val > 0:
+                raise _BadRequest(f"'{key}' must be a number > 0")
+            val = float(val)
+        out[key] = val
+    sampling = payload.get("sampling")
+    if sampling is not None:
+        if not isinstance(sampling, dict):
+            raise _BadRequest("'sampling' must be an object")
+        unknown = set(sampling) - set(_SAMPLING_KEYS)
+        if unknown:
+            raise _BadRequest(
+                f"unknown sampling keys {sorted(unknown)} — "
+                f"allowed: {list(_SAMPLING_KEYS)}")
+        try:
+            sampling = SamplingParams(**sampling)
+        except (TypeError, ValueError) as e:
+            raise _BadRequest(f"bad sampling params: {e}") from None
+    out["sampling"] = sampling
+    return out
+
+
+class FrontDoor:
+    """HTTP/SSE network edge over one :class:`~.daemon.ServingDaemon`.
+
+    ``port=0`` binds an ephemeral port (read :attr:`port` after start —
+    the test/bench pattern).  ``max_connections`` bounds concurrently
+    served connections; past it a connection is answered 503 +
+    ``Retry-After`` immediately.  ``registry`` is the MetricsRegistry
+    ``/metrics`` exposes — default: the daemon's telemetry registry when
+    one is wired, else a private one (the endpoint always works).
+    """
+
+    def __init__(self, daemon, host: str = "127.0.0.1", port: int = 0, *,
+                 max_connections: int = 64, registry=None):
+        if max_connections < 1:
+            raise ValueError(
+                f"max_connections must be >= 1, got {max_connections}")
+        self.daemon = daemon
+        self.host = host
+        self.port = int(port)          # rebound to the real port at start
+        self.max_connections = int(max_connections)
+        if registry is None and daemon._telemetry is not None:
+            registry = daemon._telemetry.registry
+        if registry is None:
+            from distributed_tensorflow_ibm_mnist_tpu.utils.telemetry import (
+                MetricsRegistry,
+            )
+            registry = MetricsRegistry()
+        self.registry = registry
+        # loop-thread-only books (mirrored into the registry for scrapes)
+        self.counters = {"connections": 0, "over_capacity": 0,
+                         "requests": 0, "streams": 0, "bad_requests": 0,
+                         "rejected_429": 0, "rejected_503": 0,
+                         "disconnects": 0, "disconnect_cancels": 0}
+        self._active = 0
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+        self.registry.inc(f"frontdoor_{name}", n)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def start(self) -> "FrontDoor":
+        """Bind and start serving on the RUNNING event loop."""
+        if self._server is not None:
+            return self
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, limit=_MAX_HEAD)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def aclose(self) -> None:
+        """Stop accepting, cancel open handlers, close the socket."""
+        if self._server is None:
+            return
+        self._server.close()
+        await self._server.wait_closed()
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        self._server = None
+
+    def start_in_thread(self) -> "FrontDoor":
+        """Run the server on a dedicated event-loop thread; returns once
+        the socket is bound (``self.port`` live).  Pair with
+        :meth:`stop`; this is the harness path for tests/benches/examples
+        whose main thread drives blocking clients."""
+        if self._thread is not None:
+            return self
+        loop = asyncio.new_event_loop()
+        ready = threading.Event()
+        boot_exc: list[BaseException] = []
+
+        def _run():
+            asyncio.set_event_loop(loop)
+            try:
+                loop.run_until_complete(self.start())
+            except BaseException as e:   # bind failure must reach caller
+                boot_exc.append(e)
+                ready.set()
+                return
+            ready.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, name="dtm-frontdoor",
+                                        daemon=True)
+        self._thread.start()
+        ready.wait()
+        if boot_exc:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+            raise boot_exc[0]
+        self._loop = loop
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Shut down a :meth:`start_in_thread` server (idempotent)."""
+        if self._thread is None:
+            return
+        fut = asyncio.run_coroutine_threadsafe(self.aclose(), self._loop)
+        try:
+            fut.result(timeout=timeout)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=timeout)
+            self._loop.close()
+            self._thread = None
+
+    def __enter__(self) -> "FrontDoor":
+        return self.start_in_thread()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # connection handling
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        task.add_done_callback(self._conn_tasks.discard)
+        self._bump("connections")
+        if self._active >= self.max_connections:
+            # bounded accept backpressure: answer, never queue unboundedly
+            self._bump("over_capacity")
+            await self._respond_json(
+                writer, 503,
+                {"error": "server at connection capacity", "retry_after_s": 1.0},
+                extra_headers={"Retry-After": "1"})
+            await self._hangup(writer)
+            return
+        self._active += 1
+        try:
+            await self._serve_one(reader, writer)
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        except Exception:
+            with _swallow():
+                await self._respond_json(
+                    writer, 500, {"error": "internal server error"})
+        finally:
+            self._active -= 1
+            await self._hangup(writer)
+
+    async def _serve_one(self, reader, writer) -> None:
+        try:
+            head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"),
+                                          timeout=30.0)
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                asyncio.TimeoutError):
+            return
+        try:
+            request_line, *header_lines = head.decode("latin-1").split("\r\n")
+            method, target, _version = request_line.split(" ", 2)
+            headers = {}
+            for line in header_lines:
+                if ":" in line:
+                    k, v = line.split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+        except ValueError:
+            await self._respond_json(writer, 400,
+                                     {"error": "malformed request"})
+            return
+        target = target.split("?", 1)[0]
+        if target == "/healthz":
+            if method != "GET":
+                await self._respond_json(writer, 405,
+                                         {"error": "use GET /healthz"})
+                return
+            await self._healthz(writer)
+        elif target == "/metrics":
+            if method != "GET":
+                await self._respond_json(writer, 405,
+                                         {"error": "use GET /metrics"})
+                return
+            await self._metrics(writer)
+        elif target == "/v1/generate":
+            if method != "POST":
+                await self._respond_json(writer, 405,
+                                         {"error": "use POST /v1/generate"})
+                return
+            await self._generate(reader, writer, headers)
+        else:
+            await self._respond_json(writer, 404,
+                                     {"error": f"no such endpoint {target}"})
+
+    # ------------------------------------------------------------------
+    # endpoints
+
+    async def _healthz(self, writer) -> None:
+        router = self.daemon.router
+        conservation = self.daemon.conservation()
+        healthy = len(router.healthy())
+        body = {
+            "status": ("ok" if healthy and conservation["conserved"]
+                       else "degraded"),
+            "healthy": healthy,
+            "n_replicas": len(router.replicas),
+            "retiring": len(router._retiring),
+            "replicas": {str(r.index): r.vitals() for r in router.replicas},
+            "conservation": conservation,
+        }
+        await self._respond_json(writer, 200 if healthy else 503, body)
+
+    async def _metrics(self, writer) -> None:
+        # to_prometheus() serializes under the registry lock — the scrape
+        # is one atomic snapshot even while pumps are counting
+        text = self.registry.to_prometheus().encode("utf-8")
+        await self._respond_raw(writer, 200, text,
+                                content_type="text/plain; version=0.0.4")
+
+    async def _generate(self, reader, writer, headers: dict) -> None:
+        self._bump("requests")
+        try:
+            length = int(headers.get("content-length", "0"))
+        except ValueError:
+            length = -1
+        if length <= 0:
+            self._bump("bad_requests")
+            await self._respond_json(
+                writer, 400, {"error": "Content-Length body required"})
+            return
+        if length > _MAX_BODY:
+            self._bump("bad_requests")
+            await self._respond_json(
+                writer, 413, {"error": f"body exceeds {_MAX_BODY} bytes"})
+            return
+        try:
+            body = await asyncio.wait_for(reader.readexactly(length),
+                                          timeout=30.0)
+            spec = _parse_generate(json.loads(body))
+        except _BadRequest as e:
+            self._bump("bad_requests")
+            await self._respond_json(writer, 400, {"error": str(e)})
+            return
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            self._bump("bad_requests")
+            await self._respond_json(writer, 400, {"error": "invalid JSON"})
+            return
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError):
+            return
+
+        loop = asyncio.get_running_loop()
+        events: asyncio.Queue = asyncio.Queue()
+
+        def on_token(_dr, tok):
+            # delivery thread → event loop: the ONE legal crossing
+            loop.call_soon_threadsafe(events.put_nowait, ("tok", int(tok)))
+
+        try:
+            dr = self.daemon.submit(
+                spec["prompt"], spec["max_new"], callback=on_token,
+                deadline_s=spec["deadline_s"], priority=spec["priority"],
+                ttft_slo_s=spec["ttft_slo_s"], tpot_slo_s=spec["tpot_slo_s"],
+                sampling=spec["sampling"])
+        except SLOUnmeetable as e:
+            self._bump("rejected_503")
+            await self._respond_reject(writer, 503, e)
+            return
+        except QueueFull as e:
+            self._bump("rejected_429")
+            await self._respond_reject(writer, 429, e)
+            return
+        except RuntimeError as e:       # daemon draining/closed
+            self._bump("rejected_503")
+            await self._respond_json(writer, 503, {"error": str(e)})
+            return
+        except ValueError as e:         # engine-level validation
+            self._bump("bad_requests")
+            await self._respond_json(writer, 400, {"error": str(e)})
+            return
+
+        # end-of-request watcher: a worker thread parks on the request's
+        # terminal event and posts the sentinel AFTER every token callback
+        # already crossed (the delivery thread runs callbacks before it
+        # sets _done, and call_soon_threadsafe preserves order)
+        async def _await_end():
+            await loop.run_in_executor(None, dr._done.wait)
+            events.put_nowait(("end", None))
+
+        end_task = asyncio.ensure_future(_await_end())
+        # disconnect watcher: the client sends nothing after the request,
+        # so a read completing means EOF/reset — the socket is gone
+        disconnect = asyncio.ensure_future(reader.read(1))
+        try:
+            if spec["stream"]:
+                self._bump("streams")
+                await self._stream_sse(writer, dr, events, disconnect)
+            else:
+                await self._collect_json(writer, dr, events, disconnect)
+        finally:
+            disconnect.cancel()
+            end_task.cancel()
+            with _swallow():
+                await asyncio.gather(end_task, disconnect,
+                                     return_exceptions=True)
+
+    async def _next_event(self, events: asyncio.Queue,
+                          disconnect: asyncio.Task):
+        """One delivery event, or ``("disconnect", None)`` the moment the
+        client hangs up with nothing pending — pending tokens drain first
+        (they are already paid for; the disconnect verdict can wait one
+        queue pop)."""
+        if not events.empty():
+            return events.get_nowait()
+        getter = asyncio.ensure_future(events.get())
+        done, _pending = await asyncio.wait(
+            {getter, disconnect}, return_when=asyncio.FIRST_COMPLETED)
+        if getter in done:
+            return getter.result()
+        getter.cancel()
+        with _swallow():
+            await getter
+        return ("disconnect", None)
+
+    def _cancel_on_disconnect(self, dr) -> None:
+        self._bump("disconnects")
+        if not dr.done:
+            self.daemon.cancel(dr, reason="client disconnected")
+            self._bump("disconnect_cancels")
+
+    async def _stream_sse(self, writer, dr, events, disconnect) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n"
+            + f"X-Request-Id: {dr.id}\r\n\r\n".encode())
+        try:
+            await writer.drain()
+            while True:
+                kind, payload = await self._next_event(events, disconnect)
+                if kind == "tok":
+                    writer.write(b"data: "
+                                 + json.dumps({"token": payload}).encode()
+                                 + b"\n\n")
+                    await writer.drain()
+                elif kind == "end":
+                    terminal = {"id": dr.id, "status": dr.status,
+                                "error": dr.error,
+                                "n_tokens": len(dr.tokens)}
+                    writer.write(b"event: end\ndata: "
+                                 + json.dumps(terminal).encode() + b"\n\n")
+                    await writer.drain()
+                    return
+                else:
+                    self._cancel_on_disconnect(dr)
+                    return
+        except (ConnectionResetError, BrokenPipeError):
+            self._cancel_on_disconnect(dr)
+
+    async def _collect_json(self, writer, dr, events, disconnect) -> None:
+        while True:
+            kind, _payload = await self._next_event(events, disconnect)
+            if kind == "end":
+                break
+            if kind == "disconnect":
+                self._cancel_on_disconnect(dr)
+                return
+        body = {"id": dr.id, "status": dr.status, "error": dr.error,
+                "tokens": list(dr.tokens)}
+        try:
+            await self._respond_json(writer, 200, body)
+        except (ConnectionResetError, BrokenPipeError):
+            self._bump("disconnects")
+
+    # ------------------------------------------------------------------
+    # response plumbing
+
+    async def _respond_reject(self, writer, code: int, exc: QueueFull) -> None:
+        """429/503 with the policy's backoff hint as a real Retry-After
+        header (integer seconds, ceil — never rounded to an instant
+        retry) AND machine-readable in the body."""
+        hint = getattr(exc, "retry_after_s", None)
+        extra = None
+        if hint is not None:
+            extra = {"Retry-After": str(max(1, math.ceil(hint)))}
+        await self._respond_json(
+            writer, code,
+            {"error": str(exc),
+             "retry_after_s": None if hint is None else round(float(hint), 6)},
+            extra_headers=extra)
+
+    async def _respond_json(self, writer, code: int, body: dict,
+                            extra_headers: dict | None = None) -> None:
+        await self._respond_raw(
+            writer, code, json.dumps(body).encode("utf-8"),
+            content_type="application/json", extra_headers=extra_headers)
+
+    async def _respond_raw(self, writer, code: int, body: bytes, *,
+                           content_type: str,
+                           extra_headers: dict | None = None) -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed", 413: "Payload Too Large",
+                  429: "Too Many Requests", 500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(code, "Unknown")
+        head = [f"HTTP/1.1 {code} {reason}",
+                f"Content-Type: {content_type}",
+                f"Content-Length: {len(body)}",
+                "Connection: close"]
+        for k, v in (extra_headers or {}).items():
+            head.append(f"{k}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body)
+        await writer.drain()
+
+    async def _hangup(self, writer) -> None:
+        with _swallow():
+            writer.close()
+            await writer.wait_closed()
+
+
+class _swallow:
+    """``with _swallow():`` — an async-teardown guard: nothing raised
+    while closing an already-dead socket should replace the real story."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return True
+
+
+# ----------------------------------------------------------------------
+# the curl-equivalent client (stdlib http.client) — example/tests/bench
+
+
+class FrontDoorClient:
+    """Blocking wire client for one :class:`FrontDoor`.
+
+    Every call opens a fresh connection (the server is
+    ``Connection: close``).  :meth:`generate` returns the parsed JSON
+    verdict; :meth:`stream` yields tokens off the SSE wire as they
+    arrive and stores the terminal event on :attr:`last_terminal` —
+    byte-level parity with :meth:`ServingDaemon.stream` is exactly what
+    the bench gates.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+        self.last_terminal: dict | None = None
+        self.last_status: int | None = None
+        self.last_headers: dict | None = None
+
+    def _request(self, method: str, path: str, payload: dict | None = None):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        body = None if payload is None else json.dumps(payload)
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"}
+                     if body is not None else {})
+        resp = conn.getresponse()
+        self.last_status = resp.status
+        self.last_headers = {k.lower(): v for k, v in resp.getheaders()}
+        return conn, resp
+
+    def _json_call(self, method: str, path: str,
+                   payload: dict | None = None) -> dict:
+        conn, resp = self._request(method, path, payload)
+        try:
+            raw = resp.read()
+        finally:
+            conn.close()
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return {"raw": raw.decode("utf-8", "replace")}
+
+    def generate(self, prompt, max_new: int, **kw) -> dict:
+        """POST /v1/generate, non-streaming; returns the JSON body (the
+        ``tokens`` list on 200, the error + ``retry_after_s`` on 4xx/5xx;
+        check :attr:`last_status`)."""
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new": int(max_new), **kw}
+        return self._json_call("POST", "/v1/generate", payload)
+
+    def stream(self, prompt, max_new: int, **kw) -> Iterator[int]:
+        """POST /v1/generate with ``stream: true``; yields each token as
+        its SSE event arrives.  On a non-200 the rejection body lands in
+        :attr:`last_terminal` and nothing is yielded."""
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new": int(max_new), "stream": True, **kw}
+        self.last_terminal = None
+        conn, resp = self._request("POST", "/v1/generate", payload)
+        try:
+            if resp.status != 200:
+                raw = resp.read()
+                try:
+                    self.last_terminal = json.loads(raw)
+                except json.JSONDecodeError:
+                    self.last_terminal = {"raw": raw.decode("utf-8", "replace")}
+                return
+            for event, data in _iter_sse(resp):
+                if event == "end":
+                    self.last_terminal = data
+                    return
+                yield int(data["token"])
+        finally:
+            conn.close()
+
+    def healthz(self) -> dict:
+        return self._json_call("GET", "/healthz")
+
+    def metrics(self) -> str:
+        conn, resp = self._request("GET", "/metrics")
+        try:
+            return resp.read().decode("utf-8")
+        finally:
+            conn.close()
+
+
+def _iter_sse(resp) -> Iterator[tuple[str, dict]]:
+    """Parse an SSE byte stream into ``(event, json_data)`` pairs.
+    ``event`` is ``"message"`` for bare ``data:`` lines (tokens) and the
+    explicit event name otherwise (the terminal ``end``)."""
+    event = "message"
+    data_lines: list[str] = []
+    for raw in resp:
+        line = raw.decode("utf-8").rstrip("\n").rstrip("\r")
+        if line.startswith("event:"):
+            event = line[len("event:"):].strip()
+        elif line.startswith("data:"):
+            data_lines.append(line[len("data:"):].strip())
+        elif line == "" and data_lines:
+            yield event, json.loads("\n".join(data_lines))
+            event = "message"
+            data_lines = []

@@ -67,6 +67,13 @@ class AdmissionPolicy:
         Feedback from the daemon's delivery thread: one request's
         observed submit→first-token latency.  Policies that predict wait
         fold it into their estimate; the base policy ignores it.
+    ``retry_after_s(queued)``
+        A backoff hint for a request rejected with ``queued`` ahead of
+        it: the predicted seconds until the tier is likely to admit
+        again, or None (no basis).  The daemon stamps it onto every
+        :class:`QueueFull`/:class:`SLOUnmeetable` it raises so protocol
+        front ends can emit real ``Retry-After`` headers; the base
+        policy predicts nothing.
     """
 
     name = "fifo"
@@ -79,6 +86,9 @@ class AdmissionPolicy:
 
     def note_first_token(self, wait_s: float) -> None:
         return
+
+    def retry_after_s(self, queued: int) -> float | None:
+        return None
 
 
 class FIFOPolicy(AdmissionPolicy):
@@ -157,3 +167,11 @@ class DeadlineAwarePolicy(PriorityPolicy):
             self.ema_wait_s = float(wait_s)
         else:
             self.ema_wait_s += self.alpha * (wait_s - self.ema_wait_s)
+
+    def retry_after_s(self, queued: int) -> float | None:
+        """Backoff hint = the same estimator the shed verdict used: the
+        predicted wait at the CURRENT depth is how long the rejected
+        caller should expect the tier to take to digest what is ahead
+        of it.  None before the first observation (cold tier — nothing
+        sheds then either)."""
+        return self.predicted_wait_s(queued)

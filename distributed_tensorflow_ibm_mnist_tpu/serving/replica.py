@@ -82,6 +82,13 @@ class Replica:
         self.tid = tracer.track(f"replica {self.index}") if tracer is not None else 0
         self.engine = None
         self.state = FAILED  # nothing to serve until spawn()
+        # elastic capacity (ISSUE 17): a retired replica is terminal-FAILED
+        # for every dispatch/liveness purpose (nothing routes to it, its
+        # pump exits) but ``retired`` records that it drained CLEAN — the
+        # autoscaler scaled it down, it did not crash — so vitals and the
+        # failover counters keep the two exits distinguishable.  restart()
+        # (warm via the compile cache) clears it on the way back up.
+        self.retired = False
         self.spawns = 0
         self.swaps = 0
         # checkpoint step of the weights this replica currently serves;
@@ -124,6 +131,7 @@ class Replica:
         self.spawn_history.append(self.spawn_s)
         self.spawns += 1
         self.state = HEALTHY
+        self.retired = False
         if self._tracer is not None:
             self._tracer.instant("replica_spawn", cat="router", tid=self.tid,
                                  replica=self.index, spawn=self.spawns,
@@ -182,6 +190,7 @@ class Replica:
             self._heartbeat_t = e.heartbeat_t
         return {
             "state": self.state,
+            "retired": self.retired,
             "role": self.role,
             "outbox": (len(e._outbox)
                        if e is not None and hasattr(e, "_outbox") else 0),

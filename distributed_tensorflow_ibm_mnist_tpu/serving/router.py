@@ -238,6 +238,11 @@ class Router:
         self._probe = probe
         self.max_drain_steps = int(max_drain_steps)
         self.tid = tracer.track("router") if tracer is not None else 0
+        # kept for elastic capacity (ISSUE 17): add_replica() builds new
+        # replicas through the SAME factory construction built with — a
+        # factory wired to the persistent compile cache makes every
+        # scale-up spawn warm, which is what makes elasticity affordable
+        self._make_engine = make_engine
         self.replicas = [
             Replica(i, make_engine, tracer=tracer,
                     role=(roles[i] if roles is not None else "both"))
@@ -274,6 +279,11 @@ class Router:
         # the zero-drop guarantee under transient backpressure
         self._orphans: list[RouterRequest] = []
         self.failovers = 0   # replicas failed over
+        self.retires = 0     # replicas drained and retired (scale-down)
+        self.scale_ups = 0   # replicas added/restarted for capacity
+        # replica indices mid-retire: DRAINING (undispatchable, still
+        # pumped) until idle, then closed clean by finish_retires()
+        self._retiring: set[int] = set()
         self.swapped_steps: list[int] = []  # checkpoint steps hot-swapped in
         # the newest (params, step) any hot_swap delivered: a restarted
         # replica re-applies these — the factory rebuilds on its ORIGINAL
@@ -309,6 +319,39 @@ class Router:
         self._dispatch(rr)   # propagates QueueFull / NoHealthyReplica
         self.requests.append(rr)
         return rr
+
+    def cancel(self, rr: RouterRequest,
+               reason: str = "cancelled by caller") -> bool:
+        """Cancel one logical request wherever it currently is (ISSUE 17
+        — the client-disconnect path).  Returns False when ``rr`` is
+        already terminal, True when cancellation was initiated.
+
+        No new teardown machinery: the deadline clocks the request rides
+        are forced into the past, so the SAME sweeps that retire a lapsed
+        deadline collect it — the engine's per-iteration sweep for
+        running/prefilling rows (slot freed, pages freed, tracer span
+        closed), ``scheduler.pop`` for engine-queued ones, the handoff
+        pump for parked prefill packets, orphan retry for unplaced
+        requests.  A deadline-cancel is the request's OWN terminal state
+        (``engine_fault`` stays False), so failover never resurrects it.
+        Call under the tier lock in the daemonized tier (the daemon's
+        :meth:`~.daemon.ServingDaemon.cancel` does)."""
+        if rr.done:
+            return False
+        rr.deadline_s = -1e18   # overdue everywhere, immediately
+        req = rr.req
+        if req is not None and req.status not in ("done", "cancelled",
+                                                  "failed"):
+            req.deadline_s = -1e18
+        elif req is None and rr.final_status is None:
+            # never dispatched (or orphaned pre-attempt): terminal now —
+            # nothing downstream holds resources for it
+            rr.final_status = "cancelled"
+            rr.final_error = reason
+        if self._tracer is not None:
+            self._tracer.instant("request_cancelled", cat="router",
+                                 tid=self.tid, request=rr.id, reason=reason)
+        return True
 
     def _wrap_callback(self, rr: RouterRequest) -> Callable:
         def _cb(_req, tok):
@@ -428,6 +471,8 @@ class Router:
                                 replica=rep.index,
                                 error=f"{type(fe).__name__}: {fe}")
         self._pump_handoffs()
+        if self._retiring:
+            self.finish_retires()
         if self._orphans:
             self._retry_orphans()
         if self._telemetry is not None:
@@ -558,6 +603,9 @@ class Router:
             "n_replicas": len(self.replicas),
             "healthy": len(self.healthy()),
             "failovers": self.failovers,
+            "retires": self.retires,
+            "scale_ups": self.scale_ups,
+            "retiring": len(self._retiring),
             "orphans": len(self._orphans),
             "router_requests": len(self.requests),
             "outstanding": sum(1 for rr in self.requests if not rr.done),
@@ -692,11 +740,101 @@ class Router:
                 f"replica {index} is {rep.state}, not failed — restart "
                 "replaces dead replicas only")
         spawn_s = rep.spawn()
+        self.scale_ups += 1
         if self._current_weights is not None:
             params, step = self._current_weights
             rep.engine.swap_params(params)  # fresh engine: trivially idle
             rep.weight_step = step
         return spawn_s
+
+    # ------------------------------------------------------------------
+    # elastic capacity (ISSUE 17): scale-up appends/restarts replicas
+    # through the construction factory; scale-down drains before closing
+
+    def add_replica(self, role: str = "both") -> Replica:
+        """Scale-up: append one fresh replica built through the SAME
+        factory this router was constructed with (warm when the factory
+        wires a persistent compile cache — the spawn reuses the program
+        family the first replica compiled).  When the tier has hot-swapped
+        weights since construction, the new replica immediately re-applies
+        the CURRENT weights and is stamped with their step, so a
+        late-spawned replica never serves the factory's stale originals
+        (the :class:`WeightWatcher` completeness check reads the stamp).
+        Returns the new replica, HEALTHY and dispatchable."""
+        if self._closed:
+            raise RuntimeError("router is closed")
+        rep = Replica(len(self.replicas), self._make_engine,
+                      tracer=self._tracer, role=role)
+        rep.spawn()
+        self.replicas.append(rep)
+        self.scale_ups += 1
+        if self._current_weights is not None:
+            params, step = self._current_weights
+            rep.engine.swap_params(params)  # fresh engine: trivially idle
+            rep.weight_step = step
+        if self._tracer is not None:
+            self._tracer.instant(
+                "replica_added", cat="router", tid=rep.tid,
+                replica=rep.index, role=rep.role,
+                spawn_s=round(rep.spawn_s, 6))
+        return rep
+
+    def begin_retire(self, index: int) -> bool:
+        """Scale-down, phase 1: mark replica ``index`` DRAINING — no new
+        dispatches or handoff landings, but its pump keeps stepping it
+        until the in-flight work retires (zero-drop by construction, the
+        same drain discipline as a weight swap).  Refused (False) when the
+        replica is not HEALTHY or when retiring it would leave the tier
+        without prefill- or decode-capable capacity — the autoscaler's
+        floor, enforced where it cannot be forgotten."""
+        rep = self.replicas[index]
+        if rep.state != HEALTHY or not rep.alive:
+            return False
+        survivors = [r for r in self.healthy() if r.index != index]
+        if not any(r.role in ("prefill", "both") for r in survivors) or \
+                not any(r.role in ("decode", "both") for r in survivors):
+            return False
+        rep.state = DRAINING
+        self._retiring.add(index)
+        if self._tracer is not None:
+            self._tracer.instant("retire_drain_begin", cat="router",
+                                 tid=rep.tid, replica=rep.index)
+        return True
+
+    def finish_retires(self) -> list[int]:
+        """Scale-down, phase 2: close every retiring replica that has
+        drained idle (no slot work, no queued work, no parked handoff
+        packets).  The idle check and the close are atomic under the
+        replica's engine guard (``_admit_guard``) so a daemon pump is
+        never mid-``step()`` when the engine closes under it.  A replica
+        that FAILED mid-drain is dropped from the retiring set — the
+        failover harvest already owns its exit.  Returns the indices
+        retired by THIS call; runs every router step / daemon watchdog
+        tick while any retire is pending."""
+        done: list[int] = []
+        for index in sorted(self._retiring):
+            rep = self.replicas[index]
+            if rep.state == FAILED or not rep.alive:
+                self._retiring.discard(index)
+                continue
+            guard = (self._admit_guard(rep)
+                     if self._admit_guard is not None
+                     else contextlib.nullcontext())
+            with guard:
+                if (rep.engine.has_work
+                        or len(getattr(rep.engine, "_outbox", ()))):
+                    continue
+                rep.close()
+            rep.state = FAILED
+            rep.retired = True
+            self._retiring.discard(index)
+            self.retires += 1
+            done.append(index)
+            if self._tracer is not None:
+                self._tracer.instant(
+                    "replica_retired", cat="router", tid=rep.tid,
+                    replica=rep.index, spawns=rep.spawns)
+        return done
 
     def swap_replica(self, rep: Replica, params) -> bool:
         """Drain → swap → re-admit ONE replica; the others keep serving.
@@ -782,8 +920,12 @@ class Router:
         merged = ServingStats.merge(self.stats_records())
         merged.update({
             "n_replicas": len(self.replicas),
-            "replicas_failed": sum(r.state == FAILED for r in self.replicas),
+            "replicas_failed": sum(r.state == FAILED and not r.retired
+                                   for r in self.replicas),
+            "replicas_retired": sum(r.retired for r in self.replicas),
             "failovers": self.failovers,
+            "retires": self.retires,
+            "scale_ups": self.scale_ups,
             "redispatches": sum(rr.redispatches for rr in self.requests),
             "router_requests": len(self.requests),
             "weight_swaps": sum(r.swaps for r in self.replicas),

@@ -46,7 +46,18 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import SamplingParams
 
 
 class QueueFull(RuntimeError):
-    """Bounded-queue backpressure: the caller must retry or shed load."""
+    """Bounded-queue backpressure: the caller must retry or shed load.
+
+    ``retry_after_s`` is the machine-readable retry hint: an estimate of
+    how long the caller should back off before the tier is likely to
+    admit again (seconds), or None when the rejecting layer has no basis
+    to predict one.  The daemon front door stamps it from the admission
+    policy's wait predictor (serving/policies.py) so a protocol server
+    can surface it as a ``Retry-After`` header instead of inventing
+    backoff client-side.
+    """
+
+    retry_after_s: float | None = None
 
 
 @dataclass

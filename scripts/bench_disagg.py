@@ -40,6 +40,15 @@ Legs over a tiny causal-LM (CPU-sized), buckets (8, 16), paged KV:
    decode replicas compile ZERO prefill/extend/insert programs, prefill
    replicas ZERO pick/window programs; and serving compiles NOTHING
    beyond prewarm (post-serve program delta == 0 on both tiers).
+6. **reshard** — the tp>1 handoff seam: prefill tp=2 and decode tp=2 on
+   DISJOINT 2-chip groups (``tp_device_groups(2, 2)`` over the armed
+   virtual-CPU platform), the full mixed drip through it.  Every page
+   crossing the handoff is assembled host-side from one mesh's shards
+   and re-laid-out onto the other's — the gate is token parity with the
+   monolithic tp=1 reference (greedy; any drift fails), plus all-done,
+   handoffs == requests, provably disjoint device groups, pools zero.
+   Skipped (recorded, gates untouched) when the host can't arm 4
+   virtual devices.
 
 Usage:  JAX_PLATFORMS=cpu python scripts/bench_disagg.py
 Emits one JSON line (``"metric": "disagg"``); exits nonzero when any
@@ -108,7 +117,7 @@ def _arrivals(longs, shorts, *, with_longs: bool):
     return arr
 
 
-def _build(roles, slots, chaos=None):
+def _build(roles, slots, chaos=None, tp=1, tp_groups=None):
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
         FIFOScheduler,
@@ -126,7 +135,9 @@ def _build(roles, slots, chaos=None):
             kv_page_size=PAGE, kv_pages=KV_PAGES,
             scheduler=FIFOScheduler(max_len=MAX_LEN, buckets=BUCKETS,
                                     max_queue=64),
-            trace_tid=tid, chaos=chaos,
+            trace_tid=tid, chaos=chaos, tp=tp,
+            tp_devices=(tp_groups[index] if tp_groups is not None
+                        else None),
             role=(roles[index] if roles is not None else "both"))
 
     router = Router(make_engine, len(slots), roles=roles, chaos=chaos)
@@ -214,6 +225,41 @@ def _pools_zero(router) -> bool:
     return True
 
 
+def _reshard_leg(longs, shorts, mono_tokens) -> dict:
+    """Leg 6: prefill tp=2 -> decode tp=2 over disjoint 2-chip groups.
+
+    The handoff path already reassembles pages host-side from the source
+    mesh's shards (kv_pool gather) and commits them under the target
+    pool's own layout; at tp=2 -> tp=2 over DISJOINT groups both halves
+    of that seam run on every delivery.  Token parity against the tp=1
+    monolithic reference proves the resharding is bit-invisible.
+    """
+    from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
+        tp_device_groups,
+    )
+
+    if len(jax.devices()) < 4:
+        return {"skipped": True,
+                "reason": f"only {len(jax.devices())} devices"}
+    groups = tp_device_groups(2, 2)
+    router, _ = _build(DISAGG_ROLES, DISAGG_SLOTS, tp=2, tp_groups=groups)
+    recs, walls = _drive(router, _arrivals(longs, shorts, with_longs=True))
+    tokens = [list(r["rr"].generated) for r in recs]
+    dev_ids = [sorted(d.id for d in rep.engine._mesh.devices.flatten())
+               for rep in router.replicas]
+    leg = _leg(recs, walls)
+    leg.update({
+        "tp": 2,
+        "handoffs": router.handoffs,
+        "device_groups": dev_ids,
+        "disjoint_devices": not (set(dev_ids[0]) & set(dev_ids[1])),
+        "token_parity": tokens == mono_tokens and all(tokens),
+        "pools_zero": _pools_zero(router),
+    })
+    router.close()
+    return leg
+
+
 def _census(warm, roles) -> dict:
     """Per-role program pins from the prewarm reports."""
     out = {}
@@ -236,10 +282,16 @@ def main() -> None:
         FaultPlan,
         FaultSpec,
     )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.hostmesh import (
+        ensure_virtual_cpu_devices,
+    )
     from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
         CompileTracker,
     )
 
+    # the reshard leg needs 2 disjoint 2-chip groups; arming BEFORE any
+    # array exists keeps the tp=1 legs on device 0 exactly as before
+    ensure_virtual_cpu_devices(8)
     tracker = CompileTracker.install()
     longs, shorts = _prompts(7)
 
@@ -293,6 +345,9 @@ def main() -> None:
     }
     router_x.close()
 
+    # -- leg 6: cross-role tp resharding over disjoint groups -----------
+    reshard = _reshard_leg(longs, shorts, mono_tokens)
+
     # -- gates ----------------------------------------------------------
     p99_c = control["short_ttft_steps_p99"] or 0.0
     p99_l = loaded["short_ttft_steps_p99"] or float("inf")
@@ -317,6 +372,15 @@ def main() -> None:
         "chaos_exactly_once": chaos["exactly_once"],
         "pools_zero": pools_d and chaos["pools_zero"],
     }
+    if not reshard.get("skipped"):
+        gates.update({
+            "reshard_token_parity": reshard["token_parity"],
+            "reshard_all_done": reshard["done"] == reshard["requests"],
+            "reshard_every_request_handed_off": (
+                reshard["handoffs"] == reshard["requests"]),
+            "reshard_disjoint_devices": reshard["disjoint_devices"],
+            "reshard_pools_zero": reshard["pools_zero"],
+        })
     record = {
         "metric": "disagg",
         "quick": QUICK,
@@ -332,6 +396,7 @@ def main() -> None:
         "monolithic": mono,
         "ttft_ratio": (round(p99_l / p99_c, 4) if p99_c else None),
         "chaos": chaos,
+        "reshard": reshard,
         "census": {"disagg": census_d, "monolithic": _census(warm_m, None),
                    "post_prewarm_programs": {
                        "disagg": serve_delta_d["n_compiled_programs"],

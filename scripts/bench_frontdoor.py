@@ -1,0 +1,316 @@
+"""Front-door wire bench: HTTP/SSE parity, failover under connected
+clients, backpressure on the wire (ISSUE 17).
+
+The frontend's claims are all STRUCTURAL (the standing CPU caveat: no
+tokens/sec numbers here), so every leg gates a correctness property of
+the protocol layer, end to end through real sockets:
+
+1. **parity** — for the same prompts, greedy AND seeded-sampled, the
+   token sequences served over the wire (unary JSON and the SSE stream,
+   parsed off the actual bytes) are identical to
+   :meth:`ServingDaemon.stream` in-process.  The transport adds nothing
+   and loses nothing.
+2. **chaos** — ``daemon-pump`` chaos kills one of two pumps while SSE
+   clients are CONNECTED and mid-stream: every stream still ends
+   ``done`` with its full token sequence delivered exactly once (the
+   wire inherits the tier's failover guarantee), and ``/healthz`` shows
+   the failover in the census.
+3. **backpressure** — a flood against a tiny admission bound with a
+   warmed :class:`DeadlineAwarePolicy`: floods see 429/503 with the
+   policy's ``Retry-After`` hint on the wire (machine-readable
+   ``retry_after_s`` in the body, integer header), the daemon counts
+   ``rejected_with_hint``, and conservation stays exact — every
+   rejection happened at the door.
+4. **observability + drain** — one ``/metrics`` scrape carries frontend
+   and tier counters together; every leg drains to ``open_spans == 0``
+   and refcount-zero pools (a wire client is not allowed to leak a slot,
+   a page, or a span).
+
+Usage:  JAX_PLATFORMS=cpu python scripts/bench_frontdoor.py
+Emits one JSON line (``"metric": "frontdoor"``); exits nonzero when any
+gate fails.  ``DTM_BENCH_QUICK=1`` shrinks the waves to a tier-1-safe
+smoke.  bench.py runs this as its ``frontdoor`` block
+(``DTM_BENCH_SKIP_FRONTDOOR=1`` skips).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+QUICK = os.environ.get("DTM_BENCH_QUICK", "") not in ("", "0")
+
+MODEL_KW = dict(num_classes=16, dim=32, depth=1, heads=2,
+                dtype=jnp.float32)
+MAX_NEW = 4
+N_PARITY = 3 if QUICK else 6
+N_CHAOS = 6 if QUICK else 12
+N_FLOOD = 8 if QUICK else 16
+WAIT_S = 120.0
+
+
+def _mk_prompts(seed: int, n: int):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(1, 16, size=(2 + i % 5,))]
+            for i in range(n)]
+
+
+def _build(chaos=None, tracer=None, n_replicas=2, max_queue=64,
+           policy=None):
+    from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        FrontDoor,
+        InferenceEngine,
+        Router,
+        ServingDaemon,
+    )
+
+    model = get_model("causal_lm", **MODEL_KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def make_engine(tid):
+        return InferenceEngine(
+            model, params, slots=2, max_len=16, kv_page_size=4,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=64),
+            tracer=tracer, trace_tid=tid, chaos=chaos)
+
+    router = Router(make_engine, n_replicas, chaos=chaos, tracer=tracer)
+    router.prewarm()
+    daemon = ServingDaemon(router, max_queue=max_queue, policy=policy,
+                           liveness_timeout_s=30.0).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    return daemon, fd
+
+
+def _pools_zero(router) -> bool:
+    for rep in router.replicas:
+        if not rep.alive or rep.engine._pool is None:
+            continue
+        eng = rep.engine
+        if eng._radix is not None:
+            stack = [eng._radix.root]
+            while stack:
+                node = stack.pop()
+                if node.ref != 0:
+                    return False
+                stack.extend(node.children.values())
+            if eng._pool.allocated != eng._radix.n_blocks:
+                return False
+        elif eng._pool.allocated != 0:
+            return False
+    return True
+
+
+def _teardown(daemon, fd) -> dict:
+    fd.stop()
+    drained = daemon.drain(timeout=30.0)
+    pools = _pools_zero(daemon.router)
+    daemon.close()
+    return {"drained_clean": drained, "pools_zero": pools}
+
+
+def leg_parity() -> dict:
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FrontDoorClient,
+        SamplingParams,
+    )
+
+    daemon, fd = _build()
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    sampled = {"temperature": 0.7, "top_k": 5, "seed": 42}
+    compared = 0
+    mismatches = []
+    for prompt in _mk_prompts(21, N_PARITY):
+        for wire_kw, sp in ((None, None),
+                            (sampled, SamplingParams(**sampled))):
+            kw = {} if wire_kw is None else {"sampling": wire_kw}
+            unary = cli.generate(prompt, MAX_NEW, **kw)["tokens"]
+            sse = list(cli.stream(prompt, MAX_NEW, **kw))
+            dr = daemon.submit(prompt, MAX_NEW, sampling=sp)
+            ref = list(daemon.stream(dr))
+            compared += 1
+            if not (unary == sse == ref):
+                mismatches.append({"prompt": prompt, "sampled": sp is not None,
+                                   "unary": unary, "sse": sse, "ref": ref})
+    out = {"compared": compared, "mismatches": mismatches,
+           **_teardown(daemon, fd)}
+    out["parity"] = not mismatches
+    return out
+
+
+def leg_chaos() -> dict:
+    """Pump kill with clients CONNECTED: the first pump to find work dies
+    (daemon-pump raise at event 0) while every request is an open SSE
+    stream on a real socket."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import FrontDoorClient
+    from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+        FaultInjector,
+        FaultPlan,
+        FaultSpec,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import Tracer
+
+    inj = FaultInjector(FaultPlan(seed=5, faults=(
+        FaultSpec(site="daemon-pump", kind="raise", at=(0,)),)))
+    tracer = Tracer()
+    daemon, fd = _build(chaos=inj, tracer=tracer)
+    prompts = _mk_prompts(22, N_CHAOS)
+    results: dict[int, dict] = {}
+    lock = threading.Lock()
+
+    def client(i, prompt):
+        cli = FrontDoorClient("127.0.0.1", fd.port, timeout=WAIT_S)
+        toks = list(cli.stream(prompt, MAX_NEW, deadline_s=WAIT_S))
+        with lock:
+            results[i] = {"tokens": toks, "terminal": cli.last_terminal}
+
+    threads = [threading.Thread(target=client, args=(i, p))
+               for i, p in enumerate(prompts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=WAIT_S)
+    # reference: the same prompts greedy through the (post-failover) tier
+    refs = [daemon.submit(p, MAX_NEW) for p in prompts]
+    ok = drops = 0
+    exactly_once = True
+    for i, dr in enumerate(refs):
+        dr.wait(timeout=WAIT_S)
+        got = results.get(i)
+        if got is None or got["terminal"] is None \
+                or got["terminal"].get("status") != "done":
+            drops += 1
+            continue
+        ok += 1
+        if got["tokens"] != list(dr.tokens) \
+                or len(got["tokens"]) != got["terminal"]["n_tokens"]:
+            exactly_once = False
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    health = cli.healthz()
+    cons = daemon.conservation()
+    out = {
+        "streams": len(prompts),
+        "streams_done": ok,
+        "drops": drops,
+        "exactly_once": exactly_once,
+        "failovers": daemon.router.failovers,
+        "pump_faults": daemon.counters["pump_faults"],
+        "healthz_spawns": sum(v["spawns"] for v in health["replicas"].values()),
+        "conserved": cons["conserved"],
+        "faults": inj.summary(),
+        **_teardown(daemon, fd),
+    }
+    out["open_spans"] = tracer.open_spans
+    return out
+
+
+def leg_backpressure() -> dict:
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        DeadlineAwarePolicy,
+        FrontDoorClient,
+    )
+
+    policy = DeadlineAwarePolicy(concurrency=4)
+    daemon, fd = _build(n_replicas=2, max_queue=3, policy=policy)
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    # warm the EMA so rejections carry a predicted wait
+    warm = cli.generate(_mk_prompts(23, 1)[0], MAX_NEW)
+    warm_ok = cli.last_status == 200 and warm.get("status") == "done"
+    flood = _mk_prompts(24, N_FLOOD)
+    statuses: list[tuple[int, float | None, str | None]] = []
+    lock = threading.Lock()
+
+    def flooder(prompt):
+        c = FrontDoorClient("127.0.0.1", fd.port, timeout=WAIT_S)
+        body = c.generate(prompt, MAX_NEW, deadline_s=WAIT_S)
+        with lock:
+            statuses.append((c.last_status, body.get("retry_after_s"),
+                             (c.last_headers or {}).get("retry-after")))
+
+    threads = [threading.Thread(target=flooder, args=(p,)) for p in flood]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=WAIT_S)
+    n_ok = sum(1 for s, _, _ in statuses if s == 200)
+    n_reject = sum(1 for s, _, _ in statuses if s in (429, 503))
+    hinted = [(s, b, h) for s, b, h in statuses
+              if s in (429, 503) and b is not None]
+    hints_consistent = all(h is not None and int(h) >= 1 and b > 0
+                           for _, b, h in hinted)
+    deadline = time.monotonic() + WAIT_S
+    while time.monotonic() < deadline:
+        cons = daemon.conservation()
+        if cons["outstanding"] == 0:
+            break
+        time.sleep(0.02)
+    metrics_text = cli.metrics()
+    out = {
+        "flood": len(flood),
+        "ok_200": n_ok,
+        "rejected_wire": n_reject,
+        "hinted": len(hinted),
+        "hints_consistent": hints_consistent,
+        "rejected_with_hint": daemon.counters["rejected_with_hint"],
+        "policy_shed": policy.shed,
+        "warm_ok": warm_ok,
+        "conserved": cons["conserved"],
+        "metrics_has_frontdoor": "frontdoor_requests" in metrics_text,
+        "metrics_has_rejects": "frontdoor_rejected" in metrics_text,
+        **_teardown(daemon, fd),
+    }
+    return out
+
+
+def main() -> None:
+    parity = leg_parity()
+    chaos = leg_chaos()
+    backpressure = leg_backpressure()
+    gates = {
+        "wire_parity": parity["parity"] and parity["compared"] >= 2,
+        "chaos_failover_happened": chaos["failovers"] >= 1
+        and chaos["pump_faults"] >= 1,
+        "chaos_zero_drops": chaos["drops"] == 0
+        and chaos["streams_done"] == chaos["streams"],
+        "chaos_exactly_once": chaos["exactly_once"],
+        "chaos_conserved": chaos["conserved"],
+        "no_open_spans": chaos["open_spans"] == 0,
+        "backpressure_rejects_on_wire": backpressure["rejected_wire"] >= 1,
+        "backpressure_hints": backpressure["hinted"] >= 1
+        and backpressure["hints_consistent"]
+        and backpressure["rejected_with_hint"] >= 1,
+        "backpressure_conserved": backpressure["conserved"],
+        "one_scrape_both_worlds": backpressure["metrics_has_frontdoor"]
+        and backpressure["metrics_has_rejects"],
+        "drained_clean": all(l["drained_clean"] and l["pools_zero"]
+                             for l in (parity, chaos, backpressure)),
+    }
+    record = {
+        "metric": "frontdoor",
+        "quick": QUICK,
+        "parity": parity,
+        "chaos": chaos,
+        "backpressure": backpressure,
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+    print(json.dumps(record), flush=True)
+    if not record["passed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

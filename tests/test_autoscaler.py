@@ -1,0 +1,444 @@
+"""Recorded arrival traces (serving/traces.py) + telemetry-driven
+elastic capacity (serving/autoscaler.py) — ISSUE 17.
+
+The decisive properties:
+
+* TRACES ARE ARTIFACTS — every generator is deterministic under its
+  seed, offsets are sorted, shapes respect their clip bounds, and a
+  trace survives a JSONL save/load round trip event-identical; SLOs are
+  stamped at replay time (:func:`with_slos`), never baked into the
+  recorded shape.
+* PER-CLASS ACCOUNTING — :func:`per_class_report` splits goodput by
+  traffic class and judges TTFT/TPOT SLOs end-to-end from delivered
+  streams; a miss in one class never hides inside the other's average.
+* ELASTIC MECHANISM — ``retire_replica`` drains before closing (zero
+  drops with in-flight work), leaves the replica ``retired`` (clean
+  exit, distinguishable from failures), and ``restart_replica`` brings
+  it back WARM with the tier's current weights; ``add_replica`` grows
+  the tier live.
+* CONTROL LOOP — hysteresis streaks gate both directions, contrary
+  evidence resets them, the floor/ceiling bound every decision, an
+  in-flight retire freezes the loop, and policy sheds register as
+  immediate up-pressure.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    ArrivalTrace,
+    Autoscaler,
+    FIFOScheduler,
+    InferenceEngine,
+    Router,
+    ServingDaemon,
+    TraceEvent,
+    bursty_trace,
+    diurnal_trace,
+    heavy_tail_trace,
+    per_class_report,
+    poisson_trace,
+    replay_trace,
+    with_slos,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving.replica import (
+    DRAINING,
+    FAILED,
+    HEALTHY,
+)
+
+KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6], [9, 1], [3, 3]]
+WAIT_S = 120.0
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = get_model("causal_lm", **KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _factory(model, params, **kw):
+    def make_engine(tid):
+        return InferenceEngine(
+            model, params, slots=2, max_len=16, kv_page_size=4,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=16),
+            trace_tid=tid, **kw)
+    return make_engine
+
+
+# ----------------------------------------------------------------------
+# traces: generators, schema, round trip
+
+
+@pytest.mark.parametrize("gen,kw", [
+    (poisson_trace, dict(rate_rps=5.0)),
+    (bursty_trace, dict(base_rps=2.0, burst_rps=25.0,
+                        burst_every_s=1.0, burst_len_s=0.25)),
+    (diurnal_trace, dict(mean_rps=5.0, period_s=4.0, depth=0.8)),
+    (heavy_tail_trace, dict(rate_rps=5.0, alpha=1.5)),
+])
+def test_generators_deterministic_sorted_bounded(gen, kw):
+    a = gen(40, seed=7, **kw)
+    b = gen(40, seed=7, **kw)
+    c = gen(40, seed=8, **kw)
+    assert a.events == b.events                      # seed-deterministic
+    assert a.events != c.events                      # seed-sensitive
+    assert len(a) == 40
+    offs = [e.t_offset for e in a]
+    assert offs == sorted(offs) and offs[0] >= 0.0
+    for ev in a:
+        assert 1 <= ev.prompt_len <= 8 and 1 <= ev.max_new <= 8
+        assert ev.cls in ("interactive", "batch")
+        assert ev.priority == (1 if ev.cls == "interactive" else 0)
+        assert ev.ttft_slo_s is None                 # shape only, no SLOs
+    counts = a.class_counts()
+    assert counts["interactive"] + counts["batch"] == 40
+
+
+def test_trace_round_trip_and_slo_stamping(tmp_path):
+    trace = heavy_tail_trace(25, 4.0, seed=3)
+    path = trace.save(tmp_path / "ht.jsonl")
+    back = ArrivalTrace.load(path)
+    assert back.name == trace.name
+    assert back.events == trace.events
+    stamped = with_slos(back, interactive_ttft_slo_s=0.5,
+                        batch_ttft_slo_s=2.0, batch_tpot_slo_s=0.1)
+    for ev in stamped:
+        if ev.cls == "interactive":
+            assert ev.ttft_slo_s == 0.5 and ev.tpot_slo_s is None
+        else:
+            assert ev.ttft_slo_s == 2.0 and ev.tpot_slo_s == 0.1
+    # the recorded artifact is untouched
+    assert all(e.ttft_slo_s is None for e in back)
+    # corrupt header is refused, not misparsed
+    bogus = tmp_path / "not_a_trace.jsonl"
+    bogus.write_text('{"schema": "something-else"}\n')
+    with pytest.raises(ValueError):
+        ArrivalTrace.load(bogus)
+
+
+def test_trace_event_validation():
+    with pytest.raises(ValueError):
+        TraceEvent(t_offset=-1.0, prompt_len=2, max_new=2)
+    with pytest.raises(ValueError):
+        TraceEvent(t_offset=0.0, prompt_len=0, max_new=2)
+    with pytest.raises(ValueError):
+        TraceEvent(t_offset=0.0, prompt_len=2, max_new=2, cls="bulk")
+    with pytest.raises(ValueError):
+        bursty_trace(5, 5.0, 2.0, seed=1, burst_every_s=1.0,
+                     burst_len_s=0.5)          # burst below base
+    with pytest.raises(ValueError):
+        diurnal_trace(5, 5.0, seed=1, period_s=1.0, depth=1.0)
+    with pytest.raises(ValueError):
+        heavy_tail_trace(5, 5.0, seed=1, alpha=1.0)
+
+
+class _FakeDr:
+    """Just enough DaemonRequest surface for per_class_report."""
+
+    def __init__(self, status, tokens, submit_t=0.0, first_token_t=None):
+        self.status = status
+        self.tokens = tokens
+        self.submit_t = submit_t
+        self.first_token_t = first_token_t
+        self.done = status is not None
+        self.rr = None
+
+
+def test_per_class_report_accounting():
+    ev_i = TraceEvent(t_offset=0.0, prompt_len=2, max_new=2,
+                      cls="interactive", ttft_slo_s=1.0)
+    ev_b = TraceEvent(t_offset=0.0, prompt_len=2, max_new=2, cls="batch")
+    outcomes = [
+        (ev_i, _FakeDr("done", [1, 2], first_token_t=0.5), [1, 2]),   # met
+        (ev_i, _FakeDr("done", [3, 4], first_token_t=2.0), [3, 4]),   # TTFT miss
+        (ev_i, None, []),                                             # rejected
+        (ev_i, _FakeDr("cancelled", []), []),
+        (ev_b, _FakeDr("done", [5], first_token_t=3.0), [5]),  # met: no SLO
+        (ev_b, _FakeDr("done", [6, 7], first_token_t=0.1), [9, 9]),  # replayed
+    ]
+    rep = per_class_report(outcomes, wall_s=10.0)
+    inter, batch = rep["per_class"]["interactive"], rep["per_class"]["batch"]
+    assert inter["offered"] == 4 and inter["accepted"] == 3
+    assert inter["rejected"] == 1 and inter["cancelled"] == 1
+    assert inter["done"] == 2 and inter["slo_met"] == 1
+    assert inter["goodput_rps"] == pytest.approx(0.1)
+    assert batch["done"] == 2 and batch["slo_met"] == 2
+    assert batch["exactly_once"] is False         # stream != final tokens
+    assert inter["exactly_once"] is True          # the miss stays in batch
+    assert rep["total"]["offered"] == 6
+    assert rep["total"]["exactly_once"] is False
+
+
+def test_replay_trace_against_live_tier(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 2)
+    daemon = ServingDaemon(router, max_queue=64).start()
+    try:
+        trace = with_slos(
+            poisson_trace(10, 20.0, seed=11, prompt_len=(2, 5),
+                          max_new=(2, 4)),
+            interactive_ttft_slo_s=30.0, batch_ttft_slo_s=30.0)
+        rep = replay_trace(daemon, trace, vocab=16, seed=1,
+                           timeout_s=WAIT_S)
+        tot = rep["total"]
+        assert tot["offered"] == 10
+        assert tot["done"] == tot["accepted"] and tot["unfinished"] == 0
+        assert tot["exactly_once"] and tot["slo_met"] == tot["done"]
+        assert daemon.conservation()["conserved"]
+        assert daemon.drain(timeout=30.0)
+    finally:
+        daemon.close()
+
+
+# ----------------------------------------------------------------------
+# elastic mechanism: retire / add / warm restart on the live daemon
+
+
+def test_retire_drains_zero_drops_then_warm_restart(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 2)
+    daemon = ServingDaemon(router, max_queue=64).start()
+    try:
+        wave = [daemon.submit(p, 6) for p in PROMPTS]
+        # retire replica 1 with the wave in flight: it must finish its
+        # accepted work before closing — scale-down drops nothing
+        assert daemon.retire_replica(1)
+        assert router.replicas[1].state in (DRAINING, FAILED)
+        for dr in wave:
+            assert dr.wait(timeout=WAIT_S)
+            assert dr.status == "done", (dr.id, dr.status, dr.error)
+        deadline = time.monotonic() + WAIT_S
+        while time.monotonic() < deadline and router._retiring:
+            time.sleep(0.02)
+        rep = router.replicas[1]
+        assert rep.state == FAILED and rep.retired and not rep.alive
+        assert router.retires == 1
+        # retired != failed in the books
+        assert router.summary()["replicas_failed"] == 0
+        assert router.summary()["replicas_retired"] == 1
+        # the floor holds: the survivor cannot retire
+        assert daemon.retire_replica(0) is False
+        # traffic still flows on the remaining replica
+        dr = daemon.submit([7, 7, 7], 4)
+        assert dr.wait(timeout=WAIT_S) and dr.status == "done"
+        # warm restart: same replica object, back to HEALTHY, current
+        # weights stamped, and dispatchable again
+        spawn_s = daemon.restart_replica(1)
+        assert spawn_s >= 0.0
+        assert router.replicas[1].state == HEALTHY
+        assert not router.replicas[1].retired
+        wave2 = [daemon.submit(p, 4) for p in PROMPTS]
+        for dr in wave2:
+            assert dr.wait(timeout=WAIT_S) and dr.status == "done"
+        cons = daemon.conservation()
+        assert cons["conserved"] and cons["failed"] == 0
+        assert daemon.drain(timeout=30.0)
+    finally:
+        daemon.close()
+
+
+def test_add_replica_grows_live_tier(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=64).start()
+    try:
+        wave = [daemon.submit(p, 4) for p in PROMPTS[:3]]
+        rep = daemon.add_replica()
+        assert rep.index == 1 and rep.state == HEALTHY
+        assert len(router.replicas) == 2
+        assert router.scale_ups == 1
+        # the new replica serves: submit enough to spread across both
+        wave += [daemon.submit(p, 4) for p in PROMPTS]
+        for dr in wave:
+            assert dr.wait(timeout=WAIT_S) and dr.status == "done"
+        served = sum(r.engine.stats.summary()["n_done"]
+                     for r in router.replicas if r.alive)
+        assert served == len(wave)
+        assert daemon.conservation()["conserved"]
+        assert daemon.drain(timeout=30.0)
+    finally:
+        daemon.close()
+
+
+# ----------------------------------------------------------------------
+# control loop (stub tier: pure logic, no model)
+
+
+class _StubEngine:
+    def __init__(self, slots=2):
+        self.slots = slots
+        self.occupied = 0
+        self._closed = False
+
+
+class _StubReplica:
+    def __init__(self, index):
+        self.index = index
+        self.state = HEALTHY
+        self.engine = _StubEngine()
+        self.retired = False
+        self.spawn_s = 0.01
+        self.load = 0.0
+
+    @property
+    def alive(self):
+        return not self.engine._closed
+
+
+class _StubPolicy:
+    def __init__(self):
+        self.shed = 0
+
+
+class _StubDaemon:
+    def __init__(self, n=2):
+        class _R:
+            pass
+        self.router = _R()
+        self.router.replicas = [_StubReplica(i) for i in range(n)]
+        self.router._retiring = set()
+        self._adm_cv = threading.Lock()
+        self._admission = []
+        self._inflight = {}
+        self.policy = _StubPolicy()
+        self._telemetry = None
+        self.retired_calls = []
+        self.added = 0
+
+    def retire_replica(self, index):
+        self.router.replicas[index].state = DRAINING
+        self.router.replicas[index].engine._closed = True
+        self.router.replicas[index].retired = True
+        self.retired_calls.append(index)
+        return True
+
+    def restart_replica(self, index):
+        rep = self.router.replicas[index]
+        rep.state = HEALTHY
+        rep.engine = _StubEngine()
+        rep.retired = False
+        return 0.005
+
+    def add_replica(self, role="both"):
+        rep = _StubReplica(len(self.router.replicas))
+        self.router.replicas.append(rep)
+        self.added += 1
+        return rep
+
+
+def test_hysteresis_streaks_and_reset():
+    stub = _StubDaemon(n=2)
+    asc = Autoscaler(stub, min_replicas=1, max_replicas=4,
+                     hysteresis_up=3, hysteresis_down=3,
+                     down_occupancy=0.5)
+    stub._admission = list(range(20))     # heavy backlog: up-pressure
+    assert asc.tick() is None
+    assert asc.tick() is None
+    # contrary evidence resets the streak
+    stub._admission = []
+    for rep in stub.router.replicas:
+        rep.engine.occupied = rep.engine.slots      # busy: no down either
+    assert asc.tick() is None
+    stub._admission = list(range(20))
+    assert asc.tick() is None                       # streak restarted at 1
+    assert asc.tick() is None
+    assert asc.tick() == "up"                       # 3 consecutive
+    assert stub.added == 1
+    assert asc.events[-1]["action"] == "up" and not asc.events[-1]["warm"]
+
+
+def test_shed_is_immediate_up_pressure():
+    stub = _StubDaemon(n=1)
+    asc = Autoscaler(stub, min_replicas=1, max_replicas=2,
+                     hysteresis_up=1, hysteresis_down=10)
+    # no backlog at all — but the policy shed someone since last tick
+    stub.policy.shed = 3
+    assert asc.tick() == "up"
+    assert asc.summary()["scale_ups"] == 1
+
+
+def test_ceiling_floor_and_freeze_while_retiring():
+    stub = _StubDaemon(n=2)
+    asc = Autoscaler(stub, min_replicas=2, max_replicas=2,
+                     hysteresis_up=1, hysteresis_down=1,
+                     down_occupancy=0.9)
+    stub._admission = list(range(50))
+    assert asc.tick() is None            # at ceiling: up vetoed
+    stub._admission = []
+    assert asc.tick() is None            # at floor: down vetoed
+    assert stub.added == 0 and stub.retired_calls == []
+    # a retire in flight freezes every decision
+    stub.router._retiring.add(1)
+    stub._admission = list(range(50))
+    assert asc.tick() is None
+    stub.router._retiring.clear()
+    assert asc.tick() == "up" or stub.added == 0  # unfrozen: ceiling still vetoes
+
+
+def test_scale_down_prefers_least_loaded_and_warm_up_prefers_retired():
+    stub = _StubDaemon(n=3)
+    stub.router.replicas[0].load = 0.5
+    stub.router.replicas[1].load = 3.0
+    stub.router.replicas[2].load = 0.5
+    asc = Autoscaler(stub, min_replicas=1, max_replicas=3,
+                     hysteresis_up=1, hysteresis_down=1,
+                     down_occupancy=0.9)
+    assert asc.tick() == "down"
+    # equal-load tie broke toward the higher index: replica 0 survives
+    assert stub.retired_calls == [2]
+    # now scale up: the retired replica restarts WARM instead of growing
+    stub.router._retiring.clear()
+    stub._admission = list(range(50))
+    assert asc.tick() == "up"
+    assert stub.added == 0                       # no new replica built
+    assert stub.router.replicas[2].state == HEALTHY
+    ev = asc.events[-1]
+    assert ev["action"] == "up" and ev["warm"] and ev["replica"] == 2
+    assert asc.chip_seconds() > 0.0
+    s = asc.summary()
+    assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+    assert s["warm_ups"] == 1 and len(s["spawn_s"]) == 1
+
+
+def test_autoscaler_threaded_runner_against_live_tier(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=64).start()
+    asc = Autoscaler(daemon, min_replicas=1, max_replicas=2,
+                     hysteresis_up=1, hysteresis_down=1000,
+                     up_backlog_per_slot=1.5, interval_s=0.02)
+    try:
+        with asc:
+            wave = [daemon.submit(p, 6) for p in PROMPTS * 3]
+            for dr in wave:
+                assert dr.wait(timeout=WAIT_S)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not asc.events:
+                time.sleep(0.02)
+        assert all(dr.status == "done" for dr in wave)
+        assert daemon.conservation()["conserved"]
+        assert daemon.drain(timeout=30.0)
+    finally:
+        asc.stop()
+        daemon.close()
+
+
+def test_autoscaler_validation():
+    stub = _StubDaemon()
+    with pytest.raises(ValueError):
+        Autoscaler(stub, min_replicas=0)
+    with pytest.raises(ValueError):
+        Autoscaler(stub, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Autoscaler(stub, hysteresis_up=0)

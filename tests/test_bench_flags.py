@@ -46,6 +46,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_CHUNKED": ["chunked_prefill"],
     "DTM_BENCH_SKIP_SLO_DAEMON": ["slo_daemon"],
     "DTM_BENCH_SKIP_DISAGG": ["disagg"],
+    "DTM_BENCH_SKIP_FRONTDOOR": ["frontdoor"],
 }
 
 

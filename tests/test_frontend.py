@@ -1,0 +1,483 @@
+"""The internet-shaped front door (serving/frontend.py) + request
+cancellation (ISSUE 17).
+
+The decisive properties:
+
+* WIRE PARITY — tokens served over HTTP (unary JSON and SSE stream) are
+  identical to :meth:`ServingDaemon.stream` for the same prompts and
+  seeds, greedy AND sampled: the protocol layer adds transport, never
+  content.
+* DISCONNECT CANCELS — a client hanging up mid-SSE-stream cancels the
+  underlying request: the slot frees, the KV pool returns to refcount
+  zero, the tracer drains to ``open_spans == 0``, and conservation stays
+  EXACT with the request counted ``cancelled`` — a vanished client costs
+  the tier nothing.
+* BACKPRESSURE ON THE WIRE — the daemon's ``QueueFull`` surfaces as 429
+  and ``SLOUnmeetable``/draining as 503, carrying the admission policy's
+  wait-predictor hint as a real ``Retry-After`` header plus a
+  machine-readable ``retry_after_s`` body field
+  (``rejected_with_hint`` counts them daemon-side).
+* PROTOCOL EDGES — validation 400s name the offending field; unknown
+  paths 404; wrong methods 405; ``/healthz`` exposes the replica census
+  + conservation; ``/metrics`` serves the shared Prometheus registry
+  with the frontend's own counters in the same scrape.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+from distributed_tensorflow_ibm_mnist_tpu.serving import (
+    DeadlineAwarePolicy,
+    FIFOScheduler,
+    FrontDoor,
+    FrontDoorClient,
+    InferenceEngine,
+    Router,
+    SamplingParams,
+    ServingDaemon,
+)
+from distributed_tensorflow_ibm_mnist_tpu.serving.frontend import (
+    _parse_generate,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.telemetry import (
+    MetricsRegistry,
+    Telemetry,
+)
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import Tracer
+
+KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6]]
+WAIT_S = 120.0
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = get_model("causal_lm", **KW)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _factory(model, params, **kw):
+    def make_engine(tid):
+        return InferenceEngine(
+            model, params, slots=2, max_len=16, kv_page_size=4,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=16),
+            trace_tid=tid, **kw)
+    return make_engine
+
+
+def _pools_refcount_zero(router):
+    for rep in router.replicas:
+        if not rep.alive:
+            continue
+        pool = getattr(rep.engine, "_pool", None)
+        if pool is None:
+            continue
+        radix = getattr(rep.engine, "_radix", None)
+        if radix is None:
+            if pool.allocated != 0:
+                return False
+            continue
+        stack = [radix.root]
+        while stack:
+            node = stack.pop()
+            if node.ref != 0:
+                return False
+            stack.extend(node.children.values())
+        if pool.allocated != radix.n_blocks:
+            return False
+    return True
+
+
+@pytest.fixture()
+def tier(model_and_params):
+    """A 2-replica daemon + front door on an ephemeral port, torn down
+    hard so a failing test never leaks the listener thread."""
+    model, params = model_and_params
+    tracer = Tracer()
+    router = Router(_factory(model, params, tracer=tracer), 2,
+                    tracer=tracer)
+    daemon = ServingDaemon(router, max_queue=32).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    try:
+        yield daemon, fd, tracer
+    finally:
+        fd.stop()
+        if not daemon._closed:
+            daemon.drain(timeout=30.0)
+            daemon.close()
+
+
+# ----------------------------------------------------------------------
+# request validation (no tier needed)
+
+
+def test_parse_generate_validation():
+    ok = _parse_generate({"prompt": [1, 2], "max_new": 3})
+    assert ok["prompt"] == [1, 2] and ok["max_new"] == 3
+    assert ok["stream"] is False and ok["sampling"] is None
+    spec = _parse_generate({"prompt": [1], "max_new": 1, "stream": True,
+                            "priority": 2, "deadline_s": 5,
+                            "sampling": {"temperature": 0.5, "seed": 7}})
+    assert spec["stream"] is True and spec["priority"] == 2
+    assert spec["deadline_s"] == 5.0
+    assert spec["sampling"] == SamplingParams(temperature=0.5, seed=7)
+    for bad in (
+            [],                                        # not an object
+            {"max_new": 2},                            # no prompt
+            {"prompt": [], "max_new": 2},              # empty prompt
+            {"prompt": [1.5], "max_new": 2},           # non-int token
+            {"prompt": [True], "max_new": 2},          # bool is not a token
+            {"prompt": [1], "max_new": 0},             # max_new < 1
+            {"prompt": [1], "max_new": 2, "deadline_s": -1},
+            {"prompt": [1], "max_new": 2, "priority": "high"},
+            {"prompt": [1], "max_new": 2, "sampling": {"beam": 4}},
+            {"prompt": [1], "max_new": 2,
+             "sampling": {"temperature": 0.0, "top_p": 0.5}},  # greedy+top_p
+    ):
+        with pytest.raises(ValueError):
+            _parse_generate(bad)
+
+
+# ----------------------------------------------------------------------
+# wire parity
+
+
+def test_http_parity_unary_stream_greedy_and_sampled(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    sampled = {"temperature": 0.7, "top_k": 5, "seed": 42}
+    for prompt in PROMPTS:
+        for sampling in (None, sampled):
+            kw = {} if sampling is None else {"sampling": sampling}
+            unary = cli.generate(prompt, 4, **kw)
+            assert cli.last_status == 200, unary
+            sse = list(cli.stream(prompt, 4, **kw))
+            assert cli.last_terminal["status"] == "done"
+            assert cli.last_terminal["n_tokens"] == len(sse)
+            dr = daemon.submit(
+                prompt, 4,
+                sampling=None if sampling is None
+                else SamplingParams(**sampling))
+            ref = list(daemon.stream(dr))
+            assert dr.status == "done"
+            # the three transports agree token-for-token
+            assert unary["tokens"] == sse == ref, (prompt, sampling)
+
+
+def test_stream_order_matches_delivery(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    streams = {}
+    lock = threading.Lock()
+
+    def worker(i, prompt):
+        toks = list(cli_for[i].stream(prompt, 4))
+        with lock:
+            streams[i] = (toks, cli_for[i].last_terminal)
+
+    cli_for = {i: FrontDoorClient("127.0.0.1", fd.port)
+               for i in range(len(PROMPTS))}
+    threads = [threading.Thread(target=worker, args=(i, p))
+               for i, p in enumerate(PROMPTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=WAIT_S)
+    assert len(streams) == len(PROMPTS)
+    for i, prompt in enumerate(PROMPTS):
+        toks, terminal = streams[i]
+        assert terminal["status"] == "done"
+        dr = daemon.submit(prompt, 4)
+        assert list(daemon.stream(dr)) == toks
+
+
+# ----------------------------------------------------------------------
+# disconnect cancels (ISSUE 17 satellite: slot + pages freed, spans
+# closed, conservation exact)
+
+
+def test_client_disconnect_mid_stream_cancels(tier):
+    daemon, fd, tracer = tier
+    body = json.dumps({"prompt": [5, 6, 7], "max_new": 6, "stream": True,
+                       "deadline_s": 60.0}).encode()
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=30)
+    sock.sendall(
+        b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    sock.recv(64)          # the stream started (headers on the wire)
+    sock.close()           # client vanishes mid-stream
+    deadline = time.monotonic() + WAIT_S
+    while time.monotonic() < deadline and fd.counters["disconnect_cancels"] < 1:
+        time.sleep(0.02)
+    assert fd.counters["disconnects"] >= 1
+    assert fd.counters["disconnect_cancels"] == 1
+    # the cancel must settle the request: nothing outstanding, counted
+    # cancelled (or done, if the hangup raced the final token), books exact
+    while time.monotonic() < deadline:
+        cons = daemon.conservation()
+        if cons["outstanding"] == 0:
+            break
+        time.sleep(0.02)
+    assert cons["outstanding"] == 0 and cons["conserved"]
+    assert cons["cancelled"] + cons["done"] == cons["submitted"]
+    assert daemon.drain(timeout=30.0)
+    # slot free, pages free, spans closed — the disconnect leaked nothing
+    for rep in daemon.router.replicas:
+        assert rep.engine.occupied == 0
+    assert _pools_refcount_zero(daemon.router)
+    assert tracer.open_spans == 0
+
+
+def test_disconnect_before_first_token_cancels_queued(tier):
+    daemon, fd, _tracer = tier
+    # wedge the admission path: fill both replicas' slots with real work
+    # so the victim waits QUEUED when its client hangs up
+    background = [daemon.submit(p, 6) for p in PROMPTS]
+    body = json.dumps({"prompt": [9, 9], "max_new": 4,
+                       "stream": True}).encode()
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=30)
+    sock.sendall(
+        b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    sock.close()           # gone before reading a byte
+    deadline = time.monotonic() + WAIT_S
+    while time.monotonic() < deadline and fd.counters["disconnects"] < 1:
+        time.sleep(0.02)
+    assert fd.counters["disconnects"] >= 1
+    for dr in background:
+        assert dr.wait(timeout=WAIT_S) and dr.status == "done"
+    while time.monotonic() < deadline:
+        cons = daemon.conservation()
+        if cons["outstanding"] == 0:
+            break
+        time.sleep(0.02)
+    assert cons["conserved"] and cons["outstanding"] == 0
+
+
+# ----------------------------------------------------------------------
+# daemon.cancel() — the API under the disconnect path
+
+
+def test_daemon_cancel_queued_and_inflight(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=32).start()
+    try:
+        # in-flight: cancel while decoding
+        first = daemon.submit([1, 2, 3], 6)
+        victims = [daemon.submit(p, 6) for p in PROMPTS]
+        doomed = victims[-1]
+        assert daemon.cancel(doomed)
+        assert doomed.wait(timeout=WAIT_S)
+        assert doomed.status == "cancelled"
+        for dr in [first] + victims[:-1]:
+            assert dr.wait(timeout=WAIT_S) and dr.status == "done"
+        # terminal request: cancel is a no-op, not an error
+        assert daemon.cancel(first) is False
+        cons = daemon.conservation()
+        assert cons["conserved"] and cons["cancelled"] >= 1
+        assert daemon.drain(timeout=30.0)
+    finally:
+        daemon.close()
+
+
+# ----------------------------------------------------------------------
+# backpressure on the wire
+
+
+def test_429_carries_policy_retry_after(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    policy = DeadlineAwarePolicy(concurrency=2)
+    daemon = ServingDaemon(router, max_queue=2, policy=policy).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    try:
+        # warm the EMA so the predictor has a basis for hints
+        warm = cli.generate(PROMPTS[0], 4)
+        assert cli.last_status == 200, warm
+        # flood past the admission bound without reading responses
+        hits = {"r429": 0, "hinted": 0}
+        results = []
+
+        def flood(p):
+            c = FrontDoorClient("127.0.0.1", fd.port)
+            r = c.generate(p, 4, deadline_s=60.0)
+            results.append((c.last_status, c.last_headers, r))
+
+        threads = [threading.Thread(target=flood, args=(PROMPTS[i % 4],))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=WAIT_S)
+        for status, headers, body in results:
+            if status == 429:
+                hits["r429"] += 1
+                assert "queue" in body["error"]
+                if body.get("retry_after_s") is not None:
+                    hits["hinted"] += 1
+                    assert "retry-after" in headers
+                    assert int(headers["retry-after"]) >= 1
+                    assert body["retry_after_s"] > 0
+        assert hits["r429"] >= 1          # the bound actually hit
+        assert hits["hinted"] >= 1        # warm predictor produced hints
+        assert daemon.counters["rejected_with_hint"] >= 1
+        deadline = time.monotonic() + WAIT_S
+        while time.monotonic() < deadline:
+            if daemon.conservation()["outstanding"] == 0:
+                break
+            time.sleep(0.02)
+        assert daemon.conservation()["conserved"]
+    finally:
+        fd.stop()
+        daemon.drain(timeout=30.0)
+        daemon.close()
+
+
+def test_503_after_drain(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=8).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    try:
+        assert cli.generate(PROMPTS[0], 2)["status"] == "done"
+        daemon.drain(timeout=30.0)
+        body = cli.generate(PROMPTS[1], 2)
+        assert cli.last_status == 503
+        assert "draining" in body["error"] or "closed" in body["error"]
+    finally:
+        fd.stop()
+        daemon.close()
+
+
+# ----------------------------------------------------------------------
+# protocol edges
+
+
+def test_protocol_edges_and_observability(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    # 400: field named in the error
+    bad = cli.generate([], 4)
+    assert cli.last_status == 400 and "prompt" in bad["error"]
+    bad = cli.generate([1], 4, sampling={"beam": 3})
+    assert cli.last_status == 400 and "beam" in bad["error"]
+    # 404 / 405
+    assert cli._json_call("GET", "/v2/nothing") is not None
+    assert cli.last_status == 404
+    cli._json_call("GET", "/v1/generate")
+    assert cli.last_status == 405
+    cli._json_call("POST", "/healthz", {})
+    assert cli.last_status == 405
+    # healthz: census + conservation
+    ok = cli.generate(PROMPTS[0], 4)
+    assert ok["status"] == "done"
+    h = cli.healthz()
+    assert cli.last_status == 200
+    assert h["status"] == "ok" and h["healthy"] == 2
+    assert set(h["replicas"]) == {"0", "1"}
+    assert h["replicas"]["0"]["state"] == "healthy"
+    assert h["conservation"]["conserved"] is True
+    # metrics: one scrape carries frontend AND tier counters
+    text = cli.metrics()
+    assert cli.last_status == 200
+    assert "frontdoor_requests" in text
+    assert "frontdoor_bad_requests" in text
+
+
+def test_healthz_degrades_when_no_replica(model_and_params):
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=8,
+                           liveness_timeout_s=300.0).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    try:
+        rep = router.replicas[0]
+        router._fail_replica(rep, RuntimeError("induced for healthz test"))
+        h = cli.healthz()
+        assert cli.last_status == 503
+        assert h["status"] == "degraded" and h["healthy"] == 0
+        assert h["replicas"]["0"]["state"] == "failed"
+    finally:
+        fd.stop()
+        daemon.close()
+
+
+def test_shared_registry_single_scrape(model_and_params):
+    model, params = model_and_params
+    registry = MetricsRegistry()
+    telemetry = Telemetry(registry=registry)
+    router = Router(_factory(model, params), 1, telemetry=telemetry)
+    daemon = ServingDaemon(router, max_queue=8).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    try:
+        assert fd.registry is registry   # resolved from daemon telemetry
+        cli = FrontDoorClient("127.0.0.1", fd.port)
+        assert cli.generate(PROMPTS[0], 2)["status"] == "done"
+        text = cli.metrics()
+        assert "frontdoor_requests" in text
+    finally:
+        fd.stop()
+        daemon.drain(timeout=30.0)
+        daemon.close()
+
+
+def test_connection_capacity_503(tier):
+    daemon, fd, _tracer = tier
+    fd.max_connections = 0               # everything is over capacity now
+    try:
+        cli = FrontDoorClient("127.0.0.1", fd.port)
+        body = cli.healthz()
+        assert cli.last_status == 503
+        assert "capacity" in body["error"]
+        assert cli.last_headers["retry-after"] == "1"
+    finally:
+        fd.max_connections = 64
+
+
+def test_start_in_thread_idempotent_stop_and_rebind_error(tier):
+    daemon, fd, _tracer = tier
+    # a second front door on the SAME port must fail to bind, loudly
+    clash = FrontDoor(daemon, port=fd.port)
+    with pytest.raises(OSError):
+        clash.start_in_thread()
+    clash.stop()        # no-op: never started
+
+
+# ----------------------------------------------------------------------
+# the front-door bench, quick form
+
+
+@pytest.mark.slow
+def test_bench_frontdoor_quick_gates():
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DTM_BENCH_QUICK="1")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "bench_frontdoor.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, (
+        f"bench_frontdoor quick failed rc={out.returncode}; "
+        f"stderr tail: {out.stderr[-800:]!r}")
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "frontdoor"
+    assert rec["passed"] is True
+    assert all(rec["gates"].values()), rec["gates"]
