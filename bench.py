@@ -63,11 +63,18 @@ scripts/bench_disagg.py, skip with DTM_BENCH_SKIP_DISAGG), and a
 daemonized tier — unary/SSE/direct-stream token parity, pump chaos
 behind live HTTP clients with zero drops and exactly-once streams, and
 admission backpressure surfacing machine-readable Retry-After hints —
-scripts/bench_frontdoor.py, skip with DTM_BENCH_SKIP_FRONTDOOR).  The
-tp_serving, train_census, quant, sampling, slo_daemon, disagg,
-frontdoor, and serving-subprocess gates (compile census budgets, the
-ISSUE 11 telemetry <=2% overhead bar, SLO/goodput counter arithmetic)
-fail the bench run (exit 3) on breach, after the record prints.
+scripts/bench_frontdoor.py, skip with DTM_BENCH_SKIP_FRONTDOOR), and a
+``crash`` block (ISSUE 18: crash durability — a serving subprocess with
+a write-ahead request journal is SIGKILLed mid-stream, the journal is
+replayed into a fresh tier, and clients stitch exactly-once transcripts
+across the crash; gates zero lost accepted requests, zero duplicated
+tokens, token parity with an uncrashed reference, steady-state journal
+overhead <=2%, and torn-tail recovery — scripts/bench_crash.py, skip
+with DTM_BENCH_SKIP_CRASH).  The tp_serving, train_census, quant,
+sampling, slo_daemon, disagg, frontdoor, crash, and serving-subprocess
+gates (compile census budgets, the ISSUE 11 telemetry <=2% overhead
+bar, SLO/goodput counter arithmetic) fail the bench run (exit 3) on
+breach, after the record prints.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
@@ -892,6 +899,51 @@ def main() -> None:
             frontdoor_gate_rc = 1
             print(f"bench: frontdoor phase failed: {e!r}", file=sys.stderr)
 
+    # crash durability (ISSUE 18): the write-ahead request journal under
+    # a real SIGKILL — a serving subprocess is killed mid-stream, the
+    # journal is replayed into a fresh tier, and clients stitch exactly-
+    # once transcripts across the crash (zero lost accepted requests,
+    # zero duplicated tokens, token parity with an uncrashed reference).
+    # Also gates steady-state journal overhead <= 2% and torn-tail
+    # recovery.  A breach FAILS the bench run (exit 3) after the record
+    # prints.  Runs scripts/bench_crash.py in a SUBPROCESS on the CPU
+    # backend.  Skippable (DTM_BENCH_SKIP_CRASH).
+    crash = None
+    crash_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_CRASH"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_crash.py")],
+                capture_output=True, text=True, timeout=560, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "crash":
+                    crash = rec
+            if crash is None or out.returncode != 0:
+                crash_gate_rc = out.returncode or 1
+                print(
+                    f"bench: crash subprocess "
+                    f"{'produced no record' if crash is None else 'FAILED (durability/exactly-once/overhead gate breach)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            crash_gate_rc = 1
+            print(f"bench: crash phase failed: {e!r}", file=sys.stderr)
+
     result = {
         "metric": "mnist_lenet5_images_per_sec_per_chip",
         "value": tput["images_per_sec_per_chip"],
@@ -1011,6 +1063,10 @@ def main() -> None:
         result["frontdoor"] = {
             k: v for k, v in frontdoor.items() if k != "metric"
         }
+    if crash is not None:
+        result["crash"] = {
+            k: v for k, v in crash.items() if k != "metric"
+        }
     # compile accounting for THIS process (phases 1/2/3 — the subprocess
     # blocks carry their own counts): cache hits don't count, so a warm
     # persistent compile cache shows up here as a LOWER program count
@@ -1025,7 +1081,7 @@ def main() -> None:
     # prints so the numbers are never lost with the verdict
     if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
             or sampling_gate_rc or chunked_gate_rc or slo_gate_rc
-            or disagg_gate_rc or frontdoor_gate_rc):
+            or disagg_gate_rc or frontdoor_gate_rc or crash_gate_rc):
         import sys
 
         sys.exit(3)

@@ -72,6 +72,19 @@ Mesh-TensorFlow separation of device program from execution driver
   telemetry-driven elastic capacity (warm scale-up through the compile
   cache + ``WeightWatcher`` stamping, drain-before-retire scale-down
   with zero drops)
+* crash durability (ISSUE 18): :class:`~.journal.RequestJournal` — an
+  append-only, checksummed, segment-rotated write-ahead request journal
+  (``admitted`` before ack / ``delivered`` high-water / ``retired``,
+  ``fsync_policy=never|interval|always``) wired through
+  ``ServingDaemon(journal=)``; :func:`~.journal.scan_journal` is the
+  torn-tail-tolerant reader and :func:`~.journal.recover` rebuilds a
+  fresh tier after SIGKILL and re-submits every incomplete request —
+  deterministic seeded sampling (ISSUE 13) re-derives the exact token
+  stream and the delivered high-water mark suppresses re-emission, so
+  streams are exactly-once ACROSS the crash.  The front door grows
+  ``Idempotency-Key`` (a retried POST binds to the original execution)
+  and SSE ``id:`` / ``Last-Event-ID`` resume, plus keep-alive ping
+  frames and a slow-loris body-read timeout.
 
 Observability (ISSUE 6): pass ``tracer=`` (utils/tracing.Tracer) to the
 engine and every request records a span tree (submit → queue → admit/
@@ -108,6 +121,15 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.frontend import (
     FrontDoor,
     FrontDoorClient,
 )
+from distributed_tensorflow_ibm_mnist_tpu.serving.journal import (
+    JournalScan,
+    JournalWriteError,
+    RecoveredRequest,
+    Recovery,
+    RequestJournal,
+    recover,
+    scan_journal,
+)
 from distributed_tensorflow_ibm_mnist_tpu.serving.kv_pool import (
     KVPagePool,
     init_paged_cache,
@@ -134,10 +156,12 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     FIFOScheduler,
     QueueFull,
     Request,
+    request_fingerprint,
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.stats import (
     ServingStats,
     slo_verdict,
+    transcript_digest,
 )
 from distributed_tensorflow_ibm_mnist_tpu.serving.traces import (
     ArrivalTrace,
@@ -163,6 +187,8 @@ __all__ = [
     "FrontDoorClient",
     "InferenceEngine",
     "FIFOScheduler",
+    "JournalScan",
+    "JournalWriteError",
     "KVPagePool",
     "NgramDrafter",
     "NoHealthyReplica",
@@ -170,8 +196,11 @@ __all__ = [
     "PriorityPolicy",
     "QueueFull",
     "RadixCache",
+    "RecoveredRequest",
+    "Recovery",
     "Replica",
     "Request",
+    "RequestJournal",
     "Router",
     "RouterRequest",
     "SLOUnmeetable",
@@ -187,7 +216,11 @@ __all__ = [
     "pages_needed",
     "per_class_report",
     "poisson_trace",
+    "recover",
     "replay_trace",
+    "request_fingerprint",
+    "scan_journal",
     "slo_verdict",
+    "transcript_digest",
     "with_slos",
 ]

@@ -73,6 +73,16 @@ pump loop (an engine-wide fault, failed over like a real one).  Chaos
 stays deterministic under threads because every site's event counter is
 its own lock-ordered sequence (chaos.py §Concurrency).
 
+Durability (ISSUE 18): ``journal=`` wires a write-ahead request journal
+(serving/journal.py).  Submit WALs the full request identity before the
+caller is acknowledged, the delivery thread appends the delivered
+high-water after each token crosses, and the terminal event appends the
+verdict — ``journal.recover()`` rebuilds a fresh tier from those three
+record streams after a SIGKILL, replaying every incomplete request with
+its prefix suppressed (streams are pure functions of their seed, so the
+replay is token-identical).  All journal touches are nil-guarded like
+chaos/telemetry: an unjournaled daemon pays nothing.
+
 Lifecycle: ``start()`` spawns the threads; ``drain(timeout)`` stops
 admission, waits for in-flight work to finish, then joins everything;
 ``close()`` after a clean drain leaves ``tracer.open_spans == 0`` and
@@ -106,7 +116,10 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.router import (
     NoHealthyReplica,
     Router,
 )
-from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import QueueFull
+from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
+    QueueFull,
+    request_fingerprint,
+)
 from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import ChaosFault
 
 _END = "end"
@@ -126,7 +139,8 @@ class DaemonRequest:
                  deadline_s: float | None, submit_t: float,
                  callback: Callable | None, priority: int = 0,
                  ttft_slo_s: float | None = None,
-                 tpot_slo_s: float | None = None, sampling=None):
+                 tpot_slo_s: float | None = None, sampling=None,
+                 idempotency_key: str | None = None, resume_from: int = 0):
         self.id = did
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
@@ -137,8 +151,26 @@ class DaemonRequest:
         self.ttft_slo_s = ttft_slo_s
         self.tpot_slo_s = tpot_slo_s
         self.sampling = sampling
+        # durability identity (serving/journal.py): the client's retry
+        # key, the replay fingerprint the front door checks key reuse
+        # against, and the delivered high-water this request resumed
+        # past (0 for anything but a crash-recovered replay)
+        self.idempotency_key = idempotency_key
+        self.fingerprint: str | None = None
+        self.resume_from = int(resume_from)
+        # True when receipt is confirmed OUTSIDE the delivery callback
+        # (the front door: tokens count as received only after the
+        # drained socket write, which journals the high-water itself —
+        # the delivery loop must not, or the mark would overstate)
+        self.external_receipt = False
+        # delivered-mark pacing (daemon-native requests): when the last
+        # mark was journaled and at what logical length — submit() sets
+        # the anchor so the first mark waits out a full interval
+        self._hw_mark_t = 0.0
+        self._hw_mark_n = 0
         self.rr = None                  # RouterRequest once dispatched
-        self.tokens: list[int] = []     # delivered tokens, in order
+        self.tokens: list[int] = []     # delivered tokens SINCE resume_from,
+        #   in order (logical index of tokens[i] is resume_from + i)
         self.first_token_t: float | None = None
         # terminal state set by the daemon (delivery thread / close)
         self.final_status: str | None = None
@@ -168,6 +200,13 @@ class DaemonRequest:
         return (np.inf if self.deadline_s is None
                 else self.submit_t + self.deadline_s)
 
+    @property
+    def total_tokens(self) -> int:
+        """LOGICAL stream length: the suppressed resumed prefix plus the
+        tokens this process delivered — what the journal's delivered
+        high-water and the SSE ``id:`` counter speak in."""
+        return self.resume_from + len(self.tokens)
+
     def wait(self, timeout: float | None = None) -> bool:
         """Block until terminal (done/cancelled/failed); False on timeout."""
         return self._done.wait(timeout)
@@ -193,12 +232,17 @@ class ServingDaemon:
                  liveness_timeout_s: float = 10.0,
                  watchdog_interval_s: float = 0.02,
                  idle_sleep_s: float = 0.0005,
-                 telemetry=None, chaos=None):
+                 telemetry=None, chaos=None, journal=None,
+                 journal_hw_interval_s: float = 0.05):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if liveness_timeout_s <= 0:
             raise ValueError(
                 f"liveness_timeout_s must be > 0, got {liveness_timeout_s}")
+        if journal_hw_interval_s < 0:
+            raise ValueError(
+                f"journal_hw_interval_s must be >= 0, "
+                f"got {journal_hw_interval_s}")
         self.router = router
         self.policy = policy if policy is not None else FIFOPolicy()
         self.max_queue = int(max_queue)
@@ -212,6 +256,26 @@ class ServingDaemon:
                            else router._telemetry)
         if self._telemetry is not None:
             self._telemetry.register_source("daemon", self._telemetry_vitals)
+        # serving/journal.RequestJournal | None — the write-ahead request
+        # journal (crash durability).  Nil-guarded like _chaos/_telemetry:
+        # an unjournaled daemon pays zero instructions per submit/token.
+        # Three journaling points: `admitted` WAL in submit() (before the
+        # ack — a raising append fails the submit), `delivered` high-water
+        # on the delivery thread AFTER each token crosses (the mark never
+        # overstates what the client got), `retired` at the terminal
+        # event.  The daemon owns the journal's lifecycle: close() syncs
+        # and closes it, like it closes the router.
+        self._journal = journal
+        # delivered-mark pacing: a mark finer than the journal's flush
+        # cadence adds ZERO durability — an unflushed mark does not
+        # survive the crash either — so per-token marks just tax the
+        # delivery thread.  Marks land at most every
+        # journal_hw_interval_s per request (matched to the journal's
+        # fsync_interval_s default), understating by at most one
+        # interval of tokens: replay re-emits that suffix and SSE ids
+        # dedup it.  The exact mark lands once, with the terminal.
+        # 0 restores per-token marks.
+        self.journal_hw_interval_s = float(journal_hw_interval_s)
 
         # the ONE lock for router-level mutations (module docstring)
         self._tier_lock = threading.RLock()
@@ -233,7 +297,8 @@ class ServingDaemon:
                          "rejected_with_hint": 0, "done": 0,
                          "cancelled": 0, "failed": 0,
                          "delivered_tokens": 0, "callback_errors": 0,
-                         "pump_faults": 0, "pump_wedges": 0}
+                         "pump_faults": 0, "pump_wedges": 0,
+                         "journal_errors": 0}
         self._work_since: dict[int, float] = {}    # watchdog anchors
         self._threads: list[threading.Thread] = []
         self._started = False
@@ -268,18 +333,29 @@ class ServingDaemon:
                callback: Callable | None = None, priority: int = 0,
                ttft_slo_s: float | None = None,
                tpot_slo_s: float | None = None,
-               sampling=None) -> DaemonRequest:
+               sampling=None, idempotency_key: str | None = None,
+               resume_from: int = 0) -> DaemonRequest:
         """Thread-safe admission.  Raises :class:`QueueFull` at the
         admission bound, :class:`~.policies.SLOUnmeetable` when the
         policy sheds, ``RuntimeError`` after drain/close.  Every raised
         rejection carries ``retry_after_s`` — the policy's wait-predictor
         backoff hint (None when it has no basis), the machine-readable
         half of a 429/503 ``Retry-After`` header (ISSUE 17).  ``callback``
-        (``cb(dr, tok)``) runs on the delivery thread, in stream order."""
+        (``cb(dr, tok)``) runs on the delivery thread, in stream order.
+
+        ``idempotency_key`` rides into the journal so a recovered tier
+        can rebind a client's retry; ``resume_from`` (crash recovery —
+        serving/journal.py) suppresses the first ``resume_from`` tokens
+        of the regenerated stream.  When a journal is wired, the
+        ``admitted`` record lands BEFORE this method returns: a raising
+        journal (:class:`~.journal.JournalWriteError`) means the request
+        was never admitted — no ack without the WAL behind it."""
         if self._closed or self._draining:
             raise RuntimeError(
                 "daemon is " + ("closed" if self._closed else "draining")
                 + " — no new requests")
+        if resume_from < 0:
+            raise ValueError(f"resume_from must be >= 0, got {resume_from}")
         with self._adm_cv:
             # bound + policy verdict decided atomically with the insert,
             # so concurrent submitters cannot oversubscribe the bound
@@ -297,11 +373,25 @@ class ServingDaemon:
                                    submit_t=self.clock(),
                                    callback=callback, priority=priority,
                                    ttft_slo_s=ttft_slo_s,
-                                   tpot_slo_s=tpot_slo_s, sampling=sampling)
+                                   tpot_slo_s=tpot_slo_s, sampling=sampling,
+                                   idempotency_key=idempotency_key,
+                                   resume_from=resume_from)
                 self.policy.admit(dr, queued)
             except QueueFull as exc:
                 self._reject(exc, queued)
                 raise
+            if self._journal is not None:
+                # write-ahead: on disk before the caller hears "yes".  A
+                # raising append propagates — the request was never
+                # admitted, so nothing is lost and nothing is counted.
+                dr.fingerprint = request_fingerprint(
+                    dr.prompt, dr.max_new, dr.sampling)
+                try:
+                    self._journal.admitted(dr)
+                except Exception:
+                    self._count("journal_errors")
+                    raise
+                dr._hw_mark_t = self.clock()
             self._ids += 1
             heapq.heappush(self._admission, (self.policy.key(dr), dr))
             self._count("submitted")
@@ -380,6 +470,8 @@ class ServingDaemon:
         never entered the tier), so this is where they surface."""
         out = self.router.summary()
         out["daemon"] = self.conservation()
+        if self._journal is not None:
+            out["journal"] = self._journal.stats()
         return out
 
     # ------------------------------------------------------------------
@@ -496,10 +588,24 @@ class ServingDaemon:
                 dr.final_status = "cancelled"
                 dr.final_error = "daemon closed with request outstanding"
                 self._count("cancelled")
+                if self._journal is not None:
+                    # leftovers bypass the delivery queue (it is already
+                    # joined) — journal their terminal verdict here so a
+                    # clean close leaves zero incomplete entries
+                    try:
+                        self._journal.retired(dr.id, "cancelled",
+                                              dr.final_error)
+                    except Exception:
+                        self._count("journal_errors")
                 dr._events.put((_END, "cancelled"))
                 dr._done.set()
         with self._tier_lock:
             self.router.close()
+        if self._journal is not None:
+            try:
+                self._journal.close()   # final flush + fsync
+            except Exception:
+                self._count("journal_errors")
         if self._telemetry is not None:
             self._telemetry.unregister_source("daemon")
 
@@ -617,7 +723,7 @@ class ServingDaemon:
                         dr.prompt, dr.max_new, deadline_s=remaining,
                         callback=self._delivery_cb(dr),
                         ttft_slo_s=dr.ttft_slo_s, tpot_slo_s=dr.tpot_slo_s,
-                        sampling=dr.sampling)
+                        sampling=dr.sampling, resume_from=dr.resume_from)
                 except QueueFull:
                     requeue = True   # transient: wait in admission
                 except NoHealthyReplica:
@@ -673,9 +779,43 @@ class ServingDaemon:
                     except Exception:
                         # a sick user callback must not kill delivery
                         self._count("callback_errors")
+                if (self._journal is not None and not dr.external_receipt
+                        and self.clock() - dr._hw_mark_t
+                        >= self.journal_hw_interval_s):
+                    # high-water AFTER the token crossed: the mark may
+                    # UNDERstate what the client holds (crash in the
+                    # seam, or the up-to-one-interval of tokens since
+                    # the last paced mark → a few replayed tokens,
+                    # deduped client-side by their SSE ids) but never
+                    # overstates — replay can re-emit, it can never
+                    # leave a gap.  A sick journal is counted, never a
+                    # delivery casualty.  For front-door requests
+                    # (external_receipt) the callback only ENQUEUES to
+                    # the event loop — marking here would overstate, so
+                    # the front door journals after each drained socket
+                    # write instead (frontend.py).
+                    dr._hw_mark_t = self.clock()
+                    dr._hw_mark_n = dr.total_tokens
+                    try:
+                        self._journal.delivered(dr.id, dr.total_tokens)
+                    except Exception:
+                        self._count("journal_errors")
             else:
                 if not dr._ended:
                     dr._ended = True
+                    if self._journal is not None:
+                        try:
+                            if (not dr.external_receipt
+                                    and dr.total_tokens > 0
+                                    and dr.total_tokens != dr._hw_mark_n):
+                                # the exact mark the pacing skipped — a
+                                # cleanly-retired request always
+                                # journals delivered == total
+                                self._journal.delivered(
+                                    dr.id, dr.total_tokens)
+                            self._journal.retired(dr.id, payload, dr.error)
+                        except Exception:
+                            self._count("journal_errors")
                     dr._events.put((_END, payload))
                     dr._done.set()
 

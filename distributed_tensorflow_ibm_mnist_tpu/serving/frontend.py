@@ -43,6 +43,24 @@ watches the socket for EOF while it streams, and a hangup calls
 free, the tracer span closes, and conservation counts it ``cancelled``
 (pinned in tests/test_frontend.py).  A disconnected client costs the
 tier at most one pump sweep, not a slot leaked until deadline.
+EXCEPT when the request carries an ``Idempotency-Key``: a keyed request
+survives its client's disconnect — retry-ability is what the key asks
+for — and a retried POST with the same key binds to the ORIGINAL
+request instead of double-executing (422 when the key is reused with a
+different body — the fingerprint check, scheduler.request_fingerprint).
+SSE events carry ``id: <logical token index>`` lines, so a reconnecting
+client sends ``Last-Event-ID`` and receives exactly the suffix it
+missed; ``FrontDoor(idempotency_bindings=recovery.bindings)`` seeds the
+dedup table across a process crash (serving/journal.py) — together
+these stitch a client transcript exactly-once across resets AND kills.
+
+Two liveness guards on the socket itself (ISSUE 18 satellites): the
+head/body read runs under ``body_timeout_s`` — a slow-loris client gets
+a 408 (counted ``frontdoor_read_timeout``) instead of holding one of
+``max_connections`` slots forever — and idle streams emit ``: ping``
+SSE comment frames every ``keepalive_s`` so proxies don't sever long
+generations and a silently-dead peer is detected BETWEEN tokens (the
+ping's write fails → cancel), not after the full generation is paid.
 
 Thread model: the server runs on ONE asyncio event loop (optionally on
 its own thread via :meth:`FrontDoor.start_in_thread` — the test/bench
@@ -69,7 +87,10 @@ from typing import Callable, Iterator
 
 from distributed_tensorflow_ibm_mnist_tpu.serving.policies import SLOUnmeetable
 from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import SamplingParams
-from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import QueueFull
+from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
+    QueueFull,
+    request_fingerprint,
+)
 
 _MAX_BODY = 1 << 20          # 1 MiB request-body bound (413 past it)
 _MAX_HEAD = 32 << 10         # request line + headers bound
@@ -137,14 +158,23 @@ class FrontDoor:
     """
 
     def __init__(self, daemon, host: str = "127.0.0.1", port: int = 0, *,
-                 max_connections: int = 64, registry=None):
+                 max_connections: int = 64, registry=None,
+                 keepalive_s: float = 15.0, body_timeout_s: float = 30.0,
+                 idempotency_bindings: dict | None = None):
         if max_connections < 1:
             raise ValueError(
                 f"max_connections must be >= 1, got {max_connections}")
+        if keepalive_s <= 0:
+            raise ValueError(f"keepalive_s must be > 0, got {keepalive_s}")
+        if body_timeout_s <= 0:
+            raise ValueError(
+                f"body_timeout_s must be > 0, got {body_timeout_s}")
         self.daemon = daemon
         self.host = host
         self.port = int(port)          # rebound to the real port at start
         self.max_connections = int(max_connections)
+        self.keepalive_s = float(keepalive_s)
+        self.body_timeout_s = float(body_timeout_s)
         if registry is None and daemon._telemetry is not None:
             registry = daemon._telemetry.registry
         if registry is None:
@@ -157,7 +187,17 @@ class FrontDoor:
         self.counters = {"connections": 0, "over_capacity": 0,
                          "requests": 0, "streams": 0, "bad_requests": 0,
                          "rejected_429": 0, "rejected_503": 0,
-                         "disconnects": 0, "disconnect_cancels": 0}
+                         "disconnects": 0, "disconnect_cancels": 0,
+                         "read_timeout": 0, "keepalive_pings": 0,
+                         "idempotent_hits": 0, "idempotent_conflicts": 0,
+                         "resumes": 0}
+        # Idempotency-Key -> (fingerprint, DaemonRequest): loop-thread-
+        # only, like the counters.  Seed with ``recovery.bindings``
+        # (serving/journal.Recovery) so retries from before a crash bind
+        # to their replayed request — the cross-crash dedup table.
+        self._idem: dict[str, tuple[str | None, object]] = {}
+        for key, dr in (idempotency_bindings or {}).items():
+            self._idem[str(key)] = (getattr(dr, "fingerprint", None), dr)
         self._active = 0
         self._server: asyncio.AbstractServer | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -279,9 +319,15 @@ class FrontDoor:
     async def _serve_one(self, reader, writer) -> None:
         try:
             head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"),
-                                          timeout=30.0)
-        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
-                asyncio.TimeoutError):
+                                          timeout=self.body_timeout_s)
+        except asyncio.TimeoutError:
+            # slow-loris: dribbling (or silent) headers past the read
+            # deadline gets a verdict and frees the slot, never holds it
+            self._bump("read_timeout")
+            await self._respond_json(
+                writer, 408, {"error": "request head read timed out"})
+            return
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError):
             return
         try:
             request_line, *header_lines = head.decode("latin-1").split("\r\n")
@@ -361,7 +407,7 @@ class FrontDoor:
             return
         try:
             body = await asyncio.wait_for(reader.readexactly(length),
-                                          timeout=30.0)
+                                          timeout=self.body_timeout_s)
             spec = _parse_generate(json.loads(body))
         except _BadRequest as e:
             self._bump("bad_requests")
@@ -371,8 +417,55 @@ class FrontDoor:
             self._bump("bad_requests")
             await self._respond_json(writer, 400, {"error": "invalid JSON"})
             return
-        except (asyncio.IncompleteReadError, asyncio.TimeoutError):
+        except asyncio.TimeoutError:
+            # slow-loris body: Content-Length promised bytes that never
+            # came — verdict + counter, the connection slot frees
+            self._bump("read_timeout")
+            await self._respond_json(
+                writer, 408, {"error": "request body read timed out"})
             return
+        except asyncio.IncompleteReadError:
+            return
+
+        idem_key = headers.get("idempotency-key") or None
+        last_event_id = None
+        if "last-event-id" in headers:
+            try:
+                last_event_id = int(headers["last-event-id"])
+            except ValueError:
+                self._bump("bad_requests")
+                await self._respond_json(
+                    writer, 400,
+                    {"error": "Last-Event-ID must be an integer token index"})
+                return
+        if idem_key is not None:
+            fp = request_fingerprint(spec["prompt"], spec["max_new"],
+                                     spec["sampling"])
+            bound = self._idem.get(idem_key)
+            if bound is not None:
+                bound_fp, bound_dr = bound
+                if bound_fp is not None and bound_fp != fp:
+                    # a key names ONE request forever — reusing it with a
+                    # different body is a client bug, not a new request
+                    self._bump("idempotent_conflicts")
+                    await self._respond_json(
+                        writer, 422,
+                        {"error": "Idempotency-Key already bound to a "
+                                  "different request body",
+                         "id": bound_dr.id})
+                    return
+                # the retry binds to the ORIGINAL request: no second
+                # execution, the stream picks up wherever the client
+                # says it left off (Last-Event-ID)
+                self._bump("idempotent_hits")
+                if spec["stream"]:
+                    self._bump("streams")
+                    self._bump("resumes")
+                    await self._stream_resume(reader, writer, bound_dr,
+                                              last_event_id)
+                else:
+                    await self._collect_rebind(writer, bound_dr)
+                return
 
         loop = asyncio.get_running_loop()
         events: asyncio.Queue = asyncio.Queue()
@@ -386,7 +479,7 @@ class FrontDoor:
                 spec["prompt"], spec["max_new"], callback=on_token,
                 deadline_s=spec["deadline_s"], priority=spec["priority"],
                 ttft_slo_s=spec["ttft_slo_s"], tpot_slo_s=spec["tpot_slo_s"],
-                sampling=spec["sampling"])
+                sampling=spec["sampling"], idempotency_key=idem_key)
         except SLOUnmeetable as e:
             self._bump("rejected_503")
             await self._respond_reject(writer, 503, e)
@@ -403,6 +496,16 @@ class FrontDoor:
             self._bump("bad_requests")
             await self._respond_json(writer, 400, {"error": str(e)})
             return
+
+        # the delivery callback only ENQUEUES to this loop — receipt is
+        # the drained socket write, so THIS side journals the delivered
+        # high-water (per token for SSE; unary clients receive nothing
+        # until the end, so a crashed unary request replays from 0)
+        dr.external_receipt = True
+        if idem_key is not None:
+            # bind AFTER a successful submit: a rejected request never
+            # occupies its key (the client's retry should get a fresh try)
+            self._idem[idem_key] = (fp, dr)
 
         # end-of-request watcher: a worker thread parks on the request's
         # terminal event and posts the sentinel AFTER every token callback
@@ -430,58 +533,162 @@ class FrontDoor:
                                      return_exceptions=True)
 
     async def _next_event(self, events: asyncio.Queue,
-                          disconnect: asyncio.Task):
+                          disconnect: asyncio.Task,
+                          timeout: float | None = None):
         """One delivery event, or ``("disconnect", None)`` the moment the
         client hangs up with nothing pending — pending tokens drain first
         (they are already paid for; the disconnect verdict can wait one
-        queue pop)."""
+        queue pop).  With ``timeout`` (the keep-alive interval), an idle
+        wait yields ``("ping", None)`` instead of parking forever."""
         if not events.empty():
             return events.get_nowait()
         getter = asyncio.ensure_future(events.get())
         done, _pending = await asyncio.wait(
-            {getter, disconnect}, return_when=asyncio.FIRST_COMPLETED)
+            {getter, disconnect}, timeout=timeout,
+            return_when=asyncio.FIRST_COMPLETED)
         if getter in done:
             return getter.result()
         getter.cancel()
         with _swallow():
             await getter
-        return ("disconnect", None)
+        if disconnect in done:
+            return ("disconnect", None)
+        return ("ping", None)
 
     def _cancel_on_disconnect(self, dr) -> None:
         self._bump("disconnects")
+        if dr.idempotency_key is not None:
+            # a keyed request SURVIVES its client's disconnect — retry-
+            # ability is what the key asks for: it stays bound in the
+            # dedup table and keeps generating, so the retried POST
+            # resumes a live stream instead of a cancelled stump
+            return
         if not dr.done:
             self.daemon.cancel(dr, reason="client disconnected")
             self._bump("disconnect_cancels")
 
+    def _journal_hw(self, dr, hw: int) -> None:
+        """Journal the delivered high-water AFTER a drained socket write
+        — the only point where the front door knows the client's kernel
+        has the bytes.  On loopback a SIGKILL still flushes drained
+        data, so this mark never overstates what the client received."""
+        j = self.daemon._journal
+        if j is None:
+            return
+        try:
+            j.delivered(dr.id, hw)
+        except Exception:
+            self.daemon._count("journal_errors")
+
+    def _sse_head(self, dr) -> bytes:
+        return (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\n"
+                b"Connection: close\r\n"
+                + f"X-Request-Id: {dr.id}\r\n\r\n".encode())
+
+    @staticmethod
+    def _sse_token(idx: int, token: int) -> bytes:
+        # the id: line is the resume cursor — a client that reconnects
+        # sends it back as Last-Event-ID and gets exactly the suffix
+        return (f"id: {idx}\n".encode() + b"data: "
+                + json.dumps({"token": token}).encode() + b"\n\n")
+
+    def _sse_terminal(self, dr) -> bytes:
+        terminal = {"id": dr.id, "status": dr.status, "error": dr.error,
+                    "n_tokens": dr.total_tokens}
+        return (b"event: end\ndata: "
+                + json.dumps(terminal).encode() + b"\n\n")
+
     async def _stream_sse(self, writer, dr, events, disconnect) -> None:
-        writer.write(
-            b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: text/event-stream\r\n"
-            b"Cache-Control: no-cache\r\n"
-            b"Connection: close\r\n"
-            + f"X-Request-Id: {dr.id}\r\n\r\n".encode())
+        writer.write(self._sse_head(dr))
+        idx = dr.resume_from   # 0 for every front-door-fresh request
         try:
             await writer.drain()
             while True:
-                kind, payload = await self._next_event(events, disconnect)
+                kind, payload = await self._next_event(
+                    events, disconnect, timeout=self.keepalive_s)
                 if kind == "tok":
-                    writer.write(b"data: "
-                                 + json.dumps({"token": payload}).encode()
-                                 + b"\n\n")
+                    writer.write(self._sse_token(idx, payload))
+                    idx += 1
                     await writer.drain()
+                    self._journal_hw(dr, idx)
                 elif kind == "end":
-                    terminal = {"id": dr.id, "status": dr.status,
-                                "error": dr.error,
-                                "n_tokens": len(dr.tokens)}
-                    writer.write(b"event: end\ndata: "
-                                 + json.dumps(terminal).encode() + b"\n\n")
+                    writer.write(self._sse_terminal(dr))
                     await writer.drain()
                     return
+                elif kind == "ping":
+                    # idle heartbeat: keeps proxies from severing a slow
+                    # generation AND probes the peer — writing to a dead
+                    # socket raises here, between tokens, not after the
+                    # whole generation was paid for
+                    self._bump("keepalive_pings")
+                    writer.write(b": ping\n\n")
+                    await writer.drain()
                 else:
                     self._cancel_on_disconnect(dr)
                     return
         except (ConnectionResetError, BrokenPipeError):
             self._cancel_on_disconnect(dr)
+
+    async def _stream_resume(self, reader, writer, dr, last_event_id) -> None:
+        """Serve an idempotent-retry SSE rebind by POLLING ``dr.tokens``
+        growth (list append is atomic; the single-slot delivery callback
+        belongs to the original connection, so a rebind cannot ride the
+        queue path).  Starts after ``Last-Event-ID`` when the client
+        sent one, else at the earliest token this process can serve
+        (``dr.resume_from`` — pre-crash tokens below it were delivered
+        to, and journaled against, the pre-crash stream)."""
+        writer.write(self._sse_head(dr))
+        start = dr.resume_from if last_event_id is None else last_event_id + 1
+        idx = max(start, dr.resume_from)
+        disconnect = asyncio.ensure_future(reader.read(1))
+        try:
+            await writer.drain()
+            idle_s = 0.0
+            while True:
+                wrote = False
+                while idx < dr.total_tokens:
+                    writer.write(self._sse_token(
+                        idx, dr.tokens[idx - dr.resume_from]))
+                    idx += 1
+                    wrote = True
+                if wrote:
+                    idle_s = 0.0
+                    await writer.drain()
+                    self._journal_hw(dr, idx)
+                if dr.done and idx >= dr.total_tokens:
+                    writer.write(self._sse_terminal(dr))
+                    await writer.drain()
+                    return
+                if disconnect.done():
+                    self._cancel_on_disconnect(dr)
+                    return
+                if idle_s >= self.keepalive_s:
+                    idle_s = 0.0
+                    self._bump("keepalive_pings")
+                    writer.write(b": ping\n\n")
+                    await writer.drain()
+                await asyncio.sleep(0.005)
+                idle_s += 0.005
+        except (ConnectionResetError, BrokenPipeError):
+            self._cancel_on_disconnect(dr)
+        finally:
+            disconnect.cancel()
+            with _swallow():
+                await disconnect
+
+    async def _collect_rebind(self, writer, dr) -> None:
+        """Unary idempotent retry: wait out the ORIGINAL request and
+        return its verdict — one execution, however many retries."""
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, dr._done.wait)
+        body = {"id": dr.id, "status": dr.status, "error": dr.error,
+                "tokens": list(dr.tokens), "resume_from": dr.resume_from}
+        try:
+            await self._respond_json(writer, 200, body)
+        except (ConnectionResetError, BrokenPipeError):
+            self._bump("disconnects")
 
     async def _collect_json(self, writer, dr, events, disconnect) -> None:
         while True:
@@ -489,6 +696,9 @@ class FrontDoor:
             if kind == "end":
                 break
             if kind == "disconnect":
+                # keyed requests keep running for a future retry
+                # (_cancel_on_disconnect skips the cancel) — but THIS
+                # socket is gone either way, stop serving it
                 self._cancel_on_disconnect(dr)
                 return
         body = {"id": dr.id, "status": dr.status, "error": dr.error,
@@ -525,7 +735,8 @@ class FrontDoor:
                            content_type: str,
                            extra_headers: dict | None = None) -> None:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  405: "Method Not Allowed", 413: "Payload Too Large",
+                  405: "Method Not Allowed", 408: "Request Timeout",
+                  413: "Payload Too Large", 422: "Unprocessable Entity",
                   429: "Too Many Requests", 500: "Internal Server Error",
                   503: "Service Unavailable"}.get(code, "Unknown")
         head = [f"HTTP/1.1 {code} {reason}",
@@ -576,22 +787,28 @@ class FrontDoorClient:
         self.last_terminal: dict | None = None
         self.last_status: int | None = None
         self.last_headers: dict | None = None
+        # highest SSE id: seen on the most recent stream() — what a
+        # reconnect sends as Last-Event-ID to resume exactly-once
+        self.last_event_id: int | None = None
 
-    def _request(self, method: str, path: str, payload: dict | None = None):
+    def _request(self, method: str, path: str, payload: dict | None = None,
+                 headers: dict | None = None):
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
         body = None if payload is None else json.dumps(payload)
-        conn.request(method, path, body=body,
-                     headers={"Content-Type": "application/json"}
-                     if body is not None else {})
+        send_headers = ({"Content-Type": "application/json"}
+                        if body is not None else {})
+        send_headers.update(headers or {})
+        conn.request(method, path, body=body, headers=send_headers)
         resp = conn.getresponse()
         self.last_status = resp.status
         self.last_headers = {k.lower(): v for k, v in resp.getheaders()}
         return conn, resp
 
     def _json_call(self, method: str, path: str,
-                   payload: dict | None = None) -> dict:
-        conn, resp = self._request(method, path, payload)
+                   payload: dict | None = None,
+                   headers: dict | None = None) -> dict:
+        conn, resp = self._request(method, path, payload, headers)
         try:
             raw = resp.read()
         finally:
@@ -601,22 +818,43 @@ class FrontDoorClient:
         except json.JSONDecodeError:
             return {"raw": raw.decode("utf-8", "replace")}
 
-    def generate(self, prompt, max_new: int, **kw) -> dict:
+    @staticmethod
+    def _retry_headers(idempotency_key, last_event_id) -> dict:
+        h = {}
+        if idempotency_key is not None:
+            h["Idempotency-Key"] = str(idempotency_key)
+        if last_event_id is not None:
+            h["Last-Event-ID"] = str(int(last_event_id))
+        return h
+
+    def generate(self, prompt, max_new: int, *,
+                 idempotency_key: str | None = None, **kw) -> dict:
         """POST /v1/generate, non-streaming; returns the JSON body (the
         ``tokens`` list on 200, the error + ``retry_after_s`` on 4xx/5xx;
-        check :attr:`last_status`)."""
+        check :attr:`last_status`).  ``idempotency_key`` makes the call
+        safe to re-issue after a connection reset: the retry binds to
+        the original execution."""
         payload = {"prompt": [int(t) for t in prompt],
                    "max_new": int(max_new), **kw}
-        return self._json_call("POST", "/v1/generate", payload)
+        return self._json_call("POST", "/v1/generate", payload,
+                               self._retry_headers(idempotency_key, None))
 
-    def stream(self, prompt, max_new: int, **kw) -> Iterator[int]:
+    def stream(self, prompt, max_new: int, *,
+               idempotency_key: str | None = None,
+               last_event_id: int | None = None, **kw) -> Iterator[int]:
         """POST /v1/generate with ``stream: true``; yields each token as
         its SSE event arrives.  On a non-200 the rejection body lands in
-        :attr:`last_terminal` and nothing is yielded."""
+        :attr:`last_terminal` and nothing is yielded.  Each event's
+        ``id:`` updates :attr:`last_event_id`; pass it back (with the
+        same ``idempotency_key``) to resume a severed stream from
+        exactly the next token."""
         payload = {"prompt": [int(t) for t in prompt],
                    "max_new": int(max_new), "stream": True, **kw}
         self.last_terminal = None
-        conn, resp = self._request("POST", "/v1/generate", payload)
+        self.last_event_id = None if last_event_id is None else int(last_event_id)
+        conn, resp = self._request(
+            "POST", "/v1/generate", payload,
+            self._retry_headers(idempotency_key, last_event_id))
         try:
             if resp.status != 200:
                 raw = resp.read()
@@ -625,10 +863,12 @@ class FrontDoorClient:
                 except json.JSONDecodeError:
                     self.last_terminal = {"raw": raw.decode("utf-8", "replace")}
                 return
-            for event, data in _iter_sse(resp):
+            for event, data, eid in _iter_sse(resp):
                 if event == "end":
                     self.last_terminal = data
                     return
+                if eid is not None:
+                    self.last_event_id = eid
                 yield int(data["token"])
         finally:
             conn.close()
@@ -644,19 +884,31 @@ class FrontDoorClient:
             conn.close()
 
 
-def _iter_sse(resp) -> Iterator[tuple[str, dict]]:
-    """Parse an SSE byte stream into ``(event, json_data)`` pairs.
+def _iter_sse(resp) -> Iterator[tuple[str, dict, int | None]]:
+    """Parse an SSE byte stream into ``(event, json_data, id)`` triples.
     ``event`` is ``"message"`` for bare ``data:`` lines (tokens) and the
-    explicit event name otherwise (the terminal ``end``)."""
+    explicit event name otherwise (the terminal ``end``).  ``id`` is the
+    logical token index from the event's ``id:`` line, ``None`` when the
+    event carries none (the terminal).  ``:`` comment lines (keep-alive
+    pings) are skipped."""
     event = "message"
+    event_id: int | None = None
     data_lines: list[str] = []
     for raw in resp:
         line = raw.decode("utf-8").rstrip("\n").rstrip("\r")
+        if line.startswith(":"):
+            continue  # comment frame — keep-alive ping, not an event
         if line.startswith("event:"):
             event = line[len("event:"):].strip()
+        elif line.startswith("id:"):
+            try:
+                event_id = int(line[len("id:"):].strip())
+            except ValueError:
+                event_id = None
         elif line.startswith("data:"):
             data_lines.append(line[len("data:"):].strip())
         elif line == "" and data_lines:
-            yield event, json.loads("\n".join(data_lines))
+            yield event, json.loads("\n".join(data_lines)), event_id
             event = "message"
+            event_id = None
             data_lines = []

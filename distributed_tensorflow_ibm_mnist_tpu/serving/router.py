@@ -124,7 +124,7 @@ class RouterRequest:
                  callback: Callable | None,
                  ttft_slo_s: float | None = None,
                  tpot_slo_s: float | None = None,
-                 sampling=None):
+                 sampling=None, resume_from: int = 0):
         self.id = rid
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.max_new = int(max_new)
@@ -148,7 +148,14 @@ class RouterRequest:
         self.attempts: list[tuple[int, Request]] = []
         self.excluded: set[int] = set()   # replicas barred for THIS request
         self.redispatches = 0
-        self.delivered = 0                # cross-attempt delivery high-water
+        # cross-attempt delivery high-water.  Seeding it above 0
+        # (``resume_from`` — crash recovery, serving/journal.py) makes
+        # the FIRST attempt replay like a failover retry: the engine
+        # regenerates the stream from scratch (pure function of the
+        # seed), and the wrapper below suppresses everything at or below
+        # the mark — the tokens a pre-crash client already received.
+        self.resume_from = int(resume_from)
+        self.delivered = self.resume_from
         self._attempt_delivered = 0       # tokens seen in the CURRENT attempt
         # router-level terminal override: set when the ROUTER ends the
         # request (deadline lapsed between attempts, no replica left)
@@ -301,7 +308,7 @@ class Router:
                callback: Callable | None = None,
                ttft_slo_s: float | None = None,
                tpot_slo_s: float | None = None,
-               sampling=None) -> RouterRequest:
+               sampling=None, resume_from: int = 0) -> RouterRequest:
         """Place one request on the least-loaded healthy replica.  Raises
         :class:`NoHealthyReplica` when no replica can be tried and
         :class:`QueueFull` when every healthy replica's queue is at bound
@@ -309,13 +316,18 @@ class Router:
         ``ttft_slo_s``/``tpot_slo_s`` ride to every attempt (see
         :class:`RouterRequest` for the per-attempt clock semantics);
         ``sampling`` (serving/sampling.SamplingParams) rides identically,
-        so a failover replay consumes the same seed."""
+        so a failover replay consumes the same seed.  ``resume_from``
+        (crash recovery — serving/journal.py) seeds the delivered
+        high-water mark: the first attempt regenerates the whole stream
+        but only tokens past the mark reach ``callback``."""
         if self._closed:
             raise RuntimeError("router is closed")
+        if resume_from < 0:
+            raise ValueError(f"resume_from must be >= 0, got {resume_from}")
         rr = RouterRequest(next(self._ids), prompt, max_new, deadline_s,
                            self.clock(), callback,
                            ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s,
-                           sampling=sampling)
+                           sampling=sampling, resume_from=resume_from)
         self._dispatch(rr)   # propagates QueueFull / NoHealthyReplica
         self.requests.append(rr)
         return rr

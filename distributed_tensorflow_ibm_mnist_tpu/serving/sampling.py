@@ -98,6 +98,21 @@ class SamplingParams:
         """The request's (2,) uint32 Threefry base key."""
         return base_key(self.seed)
 
+    def to_dict(self) -> dict:
+        """Strict-JSON form (plain floats/ints) — what the request
+        journal persists (serving/journal.py).  Round-trips exactly
+        through :meth:`from_dict`: the stream is a pure function of
+        these five numbers, which is what makes crash replay
+        token-identical."""
+        return {"temperature": float(self.temperature),
+                "top_p": float(self.top_p), "top_k": int(self.top_k),
+                "min_p": float(self.min_p), "seed": int(self.seed)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SamplingParams":
+        """Rebuild from :meth:`to_dict` output (re-validated)."""
+        return cls(**d)
+
 
 #: The default: greedy decode, seed inert.
 GREEDY = SamplingParams()

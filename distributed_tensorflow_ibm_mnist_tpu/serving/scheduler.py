@@ -33,7 +33,9 @@ for free.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+import json
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -43,6 +45,30 @@ import numpy as np
 
 from distributed_tensorflow_ibm_mnist_tpu.serving.prefix_cache import prefix_key
 from distributed_tensorflow_ibm_mnist_tpu.serving.sampling import SamplingParams
+
+
+def request_fingerprint(prompt, max_new: int, sampling=None) -> str:
+    """Content address of one generation request's REPLAY identity:
+    blake2b over the prompt tokens, the budget, and the sampling params
+    (which fully determine the token stream — sampling.py).
+
+    Two uses, both about binding identity across retries:
+
+    * the front door stores it beside each ``Idempotency-Key`` binding
+      and rejects a key REUSED with a different body (422) — a retried
+      POST must be the SAME request, not a new one wearing an old key;
+    * the request journal persists it in ``admitted`` records, so a
+      recovered binding enforces the same check across a process crash.
+
+    Deliberately excludes deadline/priority/SLOs: a client may retry
+    with a fresher deadline and still mean the same request.
+    """
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.asarray(prompt, np.int32).tobytes())
+    h.update(int(max_new).to_bytes(8, "little"))
+    if sampling is not None:
+        h.update(json.dumps(sampling.to_dict(), sort_keys=True).encode())
+    return h.hexdigest()
 
 
 class QueueFull(RuntimeError):

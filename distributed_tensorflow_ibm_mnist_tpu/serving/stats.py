@@ -72,6 +72,7 @@ latency — a deadline kill is not a service time).
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import random
 import threading
 
@@ -105,6 +106,18 @@ def percentiles(xs, qs=(50, 95, 99)) -> dict[str, float]:
         return {f"p{q}": None for q in qs}
     arr = np.asarray(xs, np.float64)
     return {f"p{q}": round(float(np.percentile(arr, q)), 6) for q in qs}
+
+
+def transcript_digest(tokens) -> str:
+    """Content address of one token transcript: blake2b over the int32
+    stream.  The token-parity primitive of the crash bench and the
+    recovery tests (serving/journal.py): a client transcript stitched
+    across a SIGKILL — pre-crash SSE prefix + post-recovery resume —
+    must digest identically to the uncrashed reference's, which is a
+    stronger statement than equal lengths and cheaper to ship in a
+    one-line bench record than the streams themselves."""
+    return hashlib.blake2b(np.asarray(tokens, np.int32).tobytes(),
+                           digest_size=16).hexdigest()
 
 
 class ServingStats:

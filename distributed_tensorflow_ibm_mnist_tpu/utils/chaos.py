@@ -65,6 +65,17 @@ Injection sites (consulted by the subsystems named in parentheses):
                           is cheap when the source trie survived), and
                           the delivered high-water mark keeps the replay
                           exactly-once per token.
+``journal-write``         one event per request-journal append
+                          (serving/journal.py).  ``kind="torn"`` lands a
+                          prefix of the encoded line and stops (the
+                          crash-mid-write signature the recovery scan
+                          must drop); ``kind="corrupt"`` flips one
+                          payload byte (bit-rot the checksum must
+                          catch); any other kind raises
+                          ``JournalWriteError`` before the write (a full
+                          disk) — fatal to the submit being journaled,
+                          counted-and-absorbed on the delivered/retired
+                          paths.
 ``daemon-pump``           one event per pump-thread activation
                           (serving/daemon.py): a pump consults the site
                           the first time it finds work to serve after
@@ -119,6 +130,7 @@ SITES = (
     "weight-swap",
     "daemon-pump",
     "kv-handoff",
+    "journal-write",
 )
 
 

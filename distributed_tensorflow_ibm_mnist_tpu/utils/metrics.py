@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import time
 from typing import Any, IO
 
@@ -43,9 +44,14 @@ class MetricWriter:
     crashing run cannot leak the handle or lose buffered events.
     """
 
-    def __init__(self, path: str | None = None, stdout: bool = True, tensorboard_dir: str | None = None):
+    def __init__(self, path: str | None = None, stdout: bool = True, tensorboard_dir: str | None = None,
+                 fsync: bool = False):
         self._file: IO[str] | None = open(path, "a") if path else None
         self._stdout = stdout
+        # fsync=True makes each record crash-durable (survives SIGKILL):
+        # every write() fsyncs the file.  Off by default — flush-only is
+        # enough for normal runs and an fsync per record is not free.
+        self._fsync = bool(fsync)
         self._t0 = time.perf_counter()
         self._tb = None
         self._closed = False
@@ -75,6 +81,8 @@ class MetricWriter:
         if self._file:
             self._file.write(line + "\n")
             self._file.flush()
+            if self._fsync:
+                os.fsync(self._file.fileno())
         if self._tb and step is not None:
             for k, v in record.items():
                 if k not in ("kind", "t", "step") and isinstance(v, (int, float)) and not isinstance(v, bool):

@@ -430,12 +430,17 @@ class Telemetry:
                  prom_path: str | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  window: int = 8, prefix: str = "dtm",
-                 registry: MetricsRegistry | None = None):
+                 registry: MetricsRegistry | None = None,
+                 fsync: bool = False):
         if interval_s <= 0:
             raise ValueError(f"interval_s must be > 0, got {interval_s}")
         self.interval_s = float(interval_s)
         self.clock = clock
         self.prefix = prefix
+        # fsync=True makes every JSONL sample and Prometheus rewrite
+        # crash-durable (survives SIGKILL, not just process exit) at the
+        # cost of one fsync per sample — the crash-bench post-mortem mode
+        self.fsync = bool(fsync)
         self.registry = (registry if registry is not None
                          else MetricsRegistry(window=window))
         self.jsonl_path = jsonl_path
@@ -518,6 +523,8 @@ class Telemetry:
             if self._file is not None:
                 self._file.write(json.dumps(record, allow_nan=False) + "\n")
                 self._file.flush()
+                if self.fsync:
+                    os.fsync(self._file.fileno())
             if self.prom_path is not None:
                 self._write_prom(record)
             self.registry.rotate()
@@ -531,6 +538,9 @@ class Telemetry:
         tmp = f"{self.prom_path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(text)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self.prom_path)  # scrapers never see a torn file
 
     def close(self) -> None:

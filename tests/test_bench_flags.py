@@ -47,6 +47,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_SLO_DAEMON": ["slo_daemon"],
     "DTM_BENCH_SKIP_DISAGG": ["disagg"],
     "DTM_BENCH_SKIP_FRONTDOOR": ["frontdoor"],
+    "DTM_BENCH_SKIP_CRASH": ["crash"],
 }
 
 

@@ -481,3 +481,207 @@ def test_bench_frontdoor_quick_gates():
     assert rec["metric"] == "frontdoor"
     assert rec["passed"] is True
     assert all(rec["gates"].values()), rec["gates"]
+
+
+# ----------------------------------------------------------------------
+# liveness guards (ISSUE 18 satellites): keep-alive pings + slow-loris
+
+
+def test_sse_keepalive_pings_on_stalled_stream(model_and_params):
+    """A stream with no tokens moving (daemon not yet started — the
+    stalled-slot regression) emits ``: ping`` comment frames every
+    ``keepalive_s``; once the tier starts, the stream completes with
+    full token parity — pings are transparent to the SSE parser."""
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=8)      # NOT started: stalled
+    fd = FrontDoor(daemon, keepalive_s=0.1).start_in_thread()
+    try:
+        cli = FrontDoorClient("127.0.0.1", fd.port)
+        got = {}
+
+        def consume():
+            got["tokens"] = list(cli.stream(PROMPTS[0], 4))
+            got["terminal"] = cli.last_terminal
+
+        t = threading.Thread(target=consume)
+        t.start()
+        deadline = time.monotonic() + WAIT_S
+        while (time.monotonic() < deadline
+               and fd.counters["keepalive_pings"] < 3):
+            time.sleep(0.02)
+        assert fd.counters["keepalive_pings"] >= 3   # idle stream kept warm
+        daemon.start()                               # un-stall the tier
+        t.join(timeout=WAIT_S)
+        assert not t.is_alive()
+        assert got["terminal"]["status"] == "done"
+        dr = daemon.submit(PROMPTS[0], 4)
+        assert got["tokens"] == list(daemon.stream(dr))
+        assert cli.last_event_id == len(got["tokens"]) - 1
+    finally:
+        fd.stop()
+        daemon.close()
+
+
+def test_slow_loris_gets_408_and_frees_capacity(model_and_params):
+    """Clients that dribble (or never send) their request hold a
+    connection slot only until ``body_timeout_s``: each gets a 408
+    (counted ``read_timeout``), and the freed capacity serves a normal
+    request afterwards — the loris flood cannot brown out the door."""
+    model, params = model_and_params
+    router = Router(_factory(model, params), 1)
+    daemon = ServingDaemon(router, max_queue=8).start()
+    fd = FrontDoor(daemon, max_connections=3,
+                   body_timeout_s=1.5).start_in_thread()
+    try:
+        loris = []
+        for i in range(3):
+            s = socket.create_connection(("127.0.0.1", fd.port), timeout=30)
+            s.settimeout(30)
+            if i == 2:
+                # complete head, promised body that never comes
+                s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+                          b"Content-Type: application/json\r\n"
+                          b"Content-Length: 64\r\n\r\n")
+            else:
+                # head never finishes
+                s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n")
+            loris.append(s)
+        # while the loris hold every slot, the door answers 503, not hangs
+        over = FrontDoorClient("127.0.0.1", fd.port, timeout=30)
+        body = over.healthz()
+        assert over.last_status == 503, body
+        assert "capacity" in body["error"]
+        # each loris gets its 408 verdict when the read deadline lapses
+        for s in loris:
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            assert b"408" in data.split(b"\r\n", 1)[0], data[:120]
+            s.close()
+        assert fd.counters["read_timeout"] == 3
+        # the slots are free again: a real request sails through
+        cli = FrontDoorClient("127.0.0.1", fd.port)
+        out = cli.generate(PROMPTS[0], 3)
+        assert cli.last_status == 200 and out["status"] == "done"
+    finally:
+        fd.stop()
+        daemon.drain(timeout=30.0)
+        daemon.close()
+
+
+# ----------------------------------------------------------------------
+# idempotency (ISSUE 18): retried POSTs bind to the original execution
+
+
+def test_idempotent_unary_retry_binds_to_original(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    first = cli.generate(PROMPTS[0], 4, idempotency_key="once")
+    assert cli.last_status == 200 and first["status"] == "done"
+    submitted = daemon.counters["submitted"]
+    retry = cli.generate(PROMPTS[0], 4, idempotency_key="once")
+    assert cli.last_status == 200
+    # same execution: same id, same tokens, NO second submit
+    assert retry["id"] == first["id"]
+    assert retry["tokens"] == first["tokens"]
+    assert retry["resume_from"] == 0
+    assert daemon.counters["submitted"] == submitted
+    assert fd.counters["idempotent_hits"] == 1
+    # the fingerprint ignores delivery metadata: a retry with a fresher
+    # deadline is the SAME request, not a conflict
+    again = cli.generate(PROMPTS[0], 4, idempotency_key="once",
+                         deadline_s=120.0)
+    assert cli.last_status == 200 and again["id"] == first["id"]
+
+
+def test_idempotency_key_reuse_different_body_422(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    first = cli.generate(PROMPTS[0], 4, idempotency_key="bound")
+    assert cli.last_status == 200
+    # different prompt under the same key: a client bug, named as such
+    clash = cli.generate(PROMPTS[1], 4, idempotency_key="bound")
+    assert cli.last_status == 422
+    assert "Idempotency-Key" in clash["error"]
+    assert clash["id"] == first["id"]
+    # different sampling is a different fingerprint too
+    cli.generate(PROMPTS[0], 4, idempotency_key="bound",
+                 sampling={"temperature": 0.5, "seed": 3})
+    assert cli.last_status == 422
+    assert fd.counters["idempotent_conflicts"] == 2
+    assert daemon.conservation()["conserved"]
+
+
+def test_keyed_disconnect_survives_and_resumes_exact_suffix(tier):
+    """The exactly-once reconnect story on one socket pair: a keyed SSE
+    client is severed mid-stream; the request keeps generating (no
+    cancel); the retry with ``Last-Event-ID`` receives exactly the
+    missing suffix, stitching a duplicate-free, gap-free transcript."""
+    daemon, fd, _tracer = tier
+    body = json.dumps({"prompt": list(PROMPTS[0]), "max_new": 6,
+                       "stream": True}).encode()
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=30)
+    sock.sendall(
+        b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Idempotency-Key: sever\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    sock.recv(64)          # stream is live on the wire
+    sock.close()           # client vanishes mid-stream
+    deadline = time.monotonic() + WAIT_S
+    while (time.monotonic() < deadline
+           and fd.counters["disconnects"] < 1):
+        time.sleep(0.02)
+    assert fd.counters["disconnects"] >= 1
+    # keyed request SURVIVES the disconnect: it runs to done, not
+    # cancelled — retry-ability is what the key asked for
+    while time.monotonic() < deadline:
+        cons = daemon.conservation()
+        if cons["outstanding"] == 0:
+            break
+        time.sleep(0.02)
+    assert cons["conserved"] and cons["outstanding"] == 0
+    assert cons["done"] == cons["submitted"] == 1
+    assert cons["cancelled"] == 0
+    assert fd.counters["disconnect_cancels"] == 0
+    # reconnect claiming tokens [0, 2) were received: the resume serves
+    # ids 2.. exactly, and prefix + suffix == the uncrashed stream
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    suffix = list(cli.stream(PROMPTS[0], 6, idempotency_key="sever",
+                             last_event_id=1))
+    assert cli.last_terminal["status"] == "done"
+    assert cli.last_terminal["n_tokens"] == 6
+    assert fd.counters["resumes"] == 1
+    dr = daemon.submit(PROMPTS[0], 6)
+    want = list(daemon.stream(dr))
+    assert suffix == want[2:]
+    assert cli.last_event_id == 5      # ids continue the logical index
+    # a second full resume from the very start replays everything
+    cli2 = FrontDoorClient("127.0.0.1", fd.port)
+    assert list(cli2.stream(PROMPTS[0], 6,
+                            idempotency_key="sever")) == want
+
+
+def test_last_event_id_must_be_integer_400(tier):
+    _daemon, fd, _tracer = tier
+    body = json.dumps({"prompt": [1, 2], "max_new": 2,
+                       "stream": True}).encode()
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=30)
+    sock.sendall(
+        b"POST /v1/generate HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Last-Event-ID: not-a-number\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    data = b""
+    sock.settimeout(30)
+    while b"\r\n\r\n" not in data:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        data += chunk
+    sock.close()
+    assert b"400" in data.split(b"\r\n", 1)[0]
