@@ -140,7 +140,8 @@ class DaemonRequest:
                  callback: Callable | None, priority: int = 0,
                  ttft_slo_s: float | None = None,
                  tpot_slo_s: float | None = None, sampling=None,
-                 idempotency_key: str | None = None, resume_from: int = 0):
+                 idempotency_key: str | None = None, resume_from: int = 0,
+                 trace_ctx=None):
         self.id = did
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
@@ -168,6 +169,15 @@ class DaemonRequest:
         # the anchor so the first mark waits out a full interval
         self._hw_mark_t = 0.0
         self._hw_mark_n = 0
+        # distributed tracing (utils/tracing.TraceContext): minted or
+        # parsed at the front door, persisted in the journal's admitted
+        # record, restored by recover() — the SAME trace id follows the
+        # request across dispatch, failover, handoff, and crash replay
+        self.trace_ctx = trace_ctx
+        self._tspan: dict | None = None  # daemon-side span bookkeeping:
+        #   {"root": daemon_request span, "admit": open admission-wait
+        #   span or None, "tid": the request's daemon track}; None when
+        #   untraced — every touch nil-guarded like the chaos hooks
         self.rr = None                  # RouterRequest once dispatched
         self.tokens: list[int] = []     # delivered tokens SINCE resume_from,
         #   in order (logical index of tokens[i] is resume_from + i)
@@ -334,7 +344,8 @@ class ServingDaemon:
                ttft_slo_s: float | None = None,
                tpot_slo_s: float | None = None,
                sampling=None, idempotency_key: str | None = None,
-               resume_from: int = 0) -> DaemonRequest:
+               resume_from: int = 0, trace_ctx=None,
+               trace_parent: int | None = None) -> DaemonRequest:
         """Thread-safe admission.  Raises :class:`QueueFull` at the
         admission bound, :class:`~.policies.SLOUnmeetable` when the
         policy sheds, ``RuntimeError`` after drain/close.  Every raised
@@ -349,7 +360,18 @@ class ServingDaemon:
         of the regenerated stream.  When a journal is wired, the
         ``admitted`` record lands BEFORE this method returns: a raising
         journal (:class:`~.journal.JournalWriteError`) means the request
-        was never admitted — no ack without the WAL behind it."""
+        was never admitted — no ack without the WAL behind it.
+
+        ``trace_ctx`` (utils/tracing.TraceContext) makes the request a
+        member of a distributed trace: the daemon opens its own span
+        lane, threads the context through the router to every engine
+        attempt, and persists the traceparent in the journal's admitted
+        record so a post-crash replay CONTINUES the same trace.
+        ``trace_parent`` is the caller's span id in the shared tier
+        tracer (the front door's http span) — the daemon span parents
+        under it; when absent the daemon span records the context's
+        ``parent_ctx`` hex edge instead, which is how a recovered
+        process's spans join the original trace in a merged export."""
         if self._closed or self._draining:
             raise RuntimeError(
                 "daemon is " + ("closed" if self._closed else "draining")
@@ -375,7 +397,8 @@ class ServingDaemon:
                                    ttft_slo_s=ttft_slo_s,
                                    tpot_slo_s=tpot_slo_s, sampling=sampling,
                                    idempotency_key=idempotency_key,
-                                   resume_from=resume_from)
+                                   resume_from=resume_from,
+                                   trace_ctx=trace_ctx)
                 self.policy.admit(dr, queued)
             except QueueFull as exc:
                 self._reject(exc, queued)
@@ -393,6 +416,24 @@ class ServingDaemon:
                     raise
                 dr._hw_mark_t = self.clock()
             self._ids += 1
+            if self._tracer is not None and trace_ctx is not None:
+                # the request's daemon lane: root span for the whole
+                # daemon-side lifetime, admit child for the admission
+                # wait.  parent = the front door's span when the tracer
+                # is shared; otherwise the W3C hex edge (parent_ctx)
+                # joins this lane to the upstream span in a merged export
+                ttid = self._tracer.track(f"dreq {dr.id}")
+                kw = dict(trace=trace_ctx.trace_id,
+                          sampled=trace_ctx.sampled, request=dr.id,
+                          resume_from=resume_from)
+                if trace_parent is None:
+                    kw["parent_ctx"] = trace_ctx.span_id
+                root = self._tracer.begin("daemon_request", cat="daemon",
+                                          parent=trace_parent, tid=ttid,
+                                          **kw)
+                admit = self._tracer.begin("admit", cat="daemon",
+                                           parent=root, tid=ttid)
+                dr._tspan = {"root": root, "admit": admit, "tid": ttid}
             heapq.heappush(self._admission, (self.policy.key(dr), dr))
             self._count("submitted")
             self._adm_cv.notify()
@@ -597,6 +638,7 @@ class ServingDaemon:
                                               dr.final_error)
                     except Exception:
                         self._count("journal_errors")
+                self._tr_close_dr(dr, "cancelled")
                 dr._events.put((_END, "cancelled"))
                 dr._done.set()
         with self._tier_lock:
@@ -723,7 +765,10 @@ class ServingDaemon:
                         dr.prompt, dr.max_new, deadline_s=remaining,
                         callback=self._delivery_cb(dr),
                         ttft_slo_s=dr.ttft_slo_s, tpot_slo_s=dr.tpot_slo_s,
-                        sampling=dr.sampling, resume_from=dr.resume_from)
+                        sampling=dr.sampling, resume_from=dr.resume_from,
+                        trace_ctx=dr.trace_ctx,
+                        trace_parent=(dr._tspan["root"]
+                                      if dr._tspan is not None else None))
                 except QueueFull:
                     requeue = True   # transient: wait in admission
                 except NoHealthyReplica:
@@ -737,6 +782,10 @@ class ServingDaemon:
                     continue
                 else:
                     dr.rr = rr
+                    if self._tracer is not None and dr._tspan is not None \
+                            and dr._tspan.get("admit") is not None:
+                        self._tracer.end(dr._tspan["admit"])
+                        dr._tspan["admit"] = None
                     with self._adm_cv:
                         self._inflight.append(dr)
             if requeue:
@@ -816,8 +865,32 @@ class ServingDaemon:
                             self._journal.retired(dr.id, payload, dr.error)
                         except Exception:
                             self._count("journal_errors")
+                    self._tr_close_dr(dr, payload)
                     dr._events.put((_END, payload))
                     dr._done.set()
+
+    def _tr_close_dr(self, dr: DaemonRequest, status: str) -> None:
+        """Close the request's daemon spans at its terminal event —
+        stamping the tail-keep signals (status / error / slo_miss /
+        redispatch count) the export-time sampler reads."""
+        if self._tracer is None or dr._tspan is None:
+            return
+        t, dr._tspan = dr._tspan, None
+        if t.get("admit") is not None:
+            self._tracer.end(t["admit"])
+        kw: dict = {"status": status}
+        error = dr.final_error if dr.final_error is not None else (
+            dr.rr.error if dr.rr is not None else None)
+        if error is not None:
+            kw["error"] = error
+        rr = dr.rr
+        req = rr.req if rr is not None else None
+        if req is not None and (req.slo_ttft_ok is False
+                                or req.slo_tpot_ok is False):
+            kw["slo_miss"] = True
+        if rr is not None and rr.redispatches:
+            kw["redispatches"] = rr.redispatches
+        self._tracer.end(t["root"], **kw)
 
     def _end_request(self, dr: DaemonRequest, status: str,
                      error: str | None) -> None:

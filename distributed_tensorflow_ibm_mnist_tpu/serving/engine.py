@@ -1111,7 +1111,15 @@ class InferenceEngine:
             else:
                 req.slo_tpot_ok = True
         if self._telemetry is not None and status == "done":
-            self._telemetry.observe("latency_s", now - req.submit_t)
+            ex = (req.trace_ctx.trace_id
+                  if req.trace_ctx is not None else None)
+            self._telemetry.observe("latency_s", now - req.submit_t,
+                                    exemplar=ex)
+            n = len(req.generated)
+            if req.first_token_t is not None and n > 1:
+                self._telemetry.observe(
+                    "tpot_s", (now - req.first_token_t) / (n - 1),
+                    exemplar=ex)
         self._slot_req[slot] = None
         self._slot_prefill[slot] = None  # a PREFILLING slot can be swept
         self._release_slot_alloc(slot)  # paged: queue its pages for release
@@ -1419,7 +1427,9 @@ class InferenceEngine:
                     req.first_token_t - req.submit_t <= req.ttft_slo_s)
             if self._telemetry is not None:
                 self._telemetry.observe(
-                    "ttft_s", req.first_token_t - req.submit_t)
+                    "ttft_s", req.first_token_t - req.submit_t,
+                    exemplar=(req.trace_ctx.trace_id
+                              if req.trace_ctx is not None else None))
                 # step()'s `produced` counts decode-window tokens only;
                 # the admit-time first token lands here so the registry
                 # counter matches stats' tokens_generated
@@ -1671,7 +1681,9 @@ class InferenceEngine:
                     req.first_token_t - req.submit_t <= req.ttft_slo_s)
             if self._telemetry is not None:
                 self._telemetry.observe(
-                    "ttft_s", req.first_token_t - req.submit_t)
+                    "ttft_s", req.first_token_t - req.submit_t,
+                    exemplar=(req.trace_ctx.trace_id
+                              if req.trace_ctx is not None else None))
                 self._telemetry.inc("tokens_generated")
             req.status = "running"
             self._tr_instant(req, "first_token", slot=slot,

@@ -62,6 +62,20 @@ SSE comment frames every ``keepalive_s`` so proxies don't sever long
 generations and a silently-dead peer is detected BETWEEN tokens (the
 ping's write fails → cancel), not after the full generation is paid.
 
+Distributed tracing (ISSUE 19): every accepted ``/v1/generate`` mints —
+or, when the client sent a W3C ``traceparent`` header, JOINS — a
+:class:`~..utils.tracing.TraceContext`, opens an ``http_request`` root
+span, and threads the context through ``daemon.submit`` so ONE trace id
+names the request from HTTP accept to the last SSE byte, across
+failover replays (span links), disagg handoffs, and journal recovery.
+Responses echo ``traceparent`` next to ``X-Request-Id``
+(client-supplied ids are honored after sanitization — satellite 2);
+429/503 sheds record a terminal ``shed`` span the tail sampler always
+keeps even at ``trace_sample_rate=0``; ``GET /v1/requests/{id}/trace``
+returns the request's correlated span tree; and ``/metrics`` speaks
+exemplar-bearing OpenMetrics when the scraper sends
+``Accept: application/openmetrics-text``.
+
 Thread model: the server runs on ONE asyncio event loop (optionally on
 its own thread via :meth:`FrontDoor.start_in_thread` — the test/bench
 harness path).  Handler coroutines touch the daemon only through its
@@ -91,10 +105,31 @@ from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
     QueueFull,
     request_fingerprint,
 )
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+    TraceContext,
+    TraceSampler,
+)
 
 _MAX_BODY = 1 << 20          # 1 MiB request-body bound (413 past it)
 _MAX_HEAD = 32 << 10         # request line + headers bound
 _SAMPLING_KEYS = ("temperature", "top_p", "top_k", "min_p", "seed")
+_MAX_RID = 64                # client X-Request-Id length cap
+_RID_OK = set("abcdefghijklmnopqrstuvwxyz"
+              "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._:-")
+_TRACED_CAP = 512            # request-id -> trace-id map bound
+
+
+def _sanitize_request_id(raw) -> str | None:
+    """Validate a client-supplied ``X-Request-Id``: non-empty, at most
+    ``_MAX_RID`` chars, drawn from ``[A-Za-z0-9._:-]``.  Anything else
+    returns None and the front door falls back to its own id — a hostile
+    header can never inject header-splitting bytes into the echo or an
+    unbounded key into the trace map."""
+    if not isinstance(raw, str) or not raw:
+        return None
+    if len(raw) > _MAX_RID or not set(raw) <= _RID_OK:
+        return None
+    return raw
 
 
 class _BadRequest(ValueError):
@@ -160,7 +195,8 @@ class FrontDoor:
     def __init__(self, daemon, host: str = "127.0.0.1", port: int = 0, *,
                  max_connections: int = 64, registry=None,
                  keepalive_s: float = 15.0, body_timeout_s: float = 30.0,
-                 idempotency_bindings: dict | None = None):
+                 idempotency_bindings: dict | None = None,
+                 tracer=None, trace_sample_rate: float = 1.0):
         if max_connections < 1:
             raise ValueError(
                 f"max_connections must be >= 1, got {max_connections}")
@@ -198,6 +234,16 @@ class FrontDoor:
         self._idem: dict[str, tuple[str | None, object]] = {}
         for key, dr in (idempotency_bindings or {}).items():
             self._idem[str(key)] = (getattr(dr, "fingerprint", None), dr)
+        # distributed tracing: default to the daemon's tracer so the
+        # http_request span parents the daemon/engine spans by plain int
+        # id (one in-process tracer end to end); an explicitly different
+        # tracer still joins via the span_ctx/parent_ctx hex edges
+        self._tracer = (tracer if tracer is not None
+                        else getattr(daemon, "_tracer", None))
+        self.sampler = TraceSampler(rate=trace_sample_rate)
+        # request id (client-supplied or daemon) -> trace id, bounded
+        # FIFO — the lookup table behind GET /v1/requests/{id}/trace
+        self._traced: dict[str, str] = {}
         self._active = 0
         self._server: asyncio.AbstractServer | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -207,6 +253,80 @@ class FrontDoor:
     def _bump(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
         self.registry.inc(f"frontdoor_{name}", n)
+
+    # ------------------------------------------------------------------
+    # distributed tracing (ISSUE 19)
+
+    def _trace_begin(self, headers: dict, **span_args):
+        """Mint — or, given a valid client ``traceparent``, JOIN — the
+        request's trace context and open the ``http_request`` root span
+        on its own viewer track.  The context is built even with no
+        tracer wired (the header echo and the journal's trace
+        persistence need it); the span carries ``span_ctx`` so a
+        different-tracer daemon still connects via the hex edge, and a
+        client parent lands as a ``parent_ctx`` edge pointing out of
+        this process.  Returns ``(ctx, ts)`` where ``ts`` is the span
+        bookkeeping dict (None when tracing is off)."""
+        client = TraceContext.parse_traceparent(headers.get("traceparent"))
+        if client is not None:
+            ctx = client.child()   # same trace id, our own span id,
+            #   the CLIENT's head-sampling verdict honored as-is
+        else:
+            ctx = TraceContext.mint()
+            ctx.sampled = self.sampler.head(ctx.trace_id)
+        ts = None
+        if self._tracer is not None:
+            kw = dict(trace=ctx.trace_id, sampled=ctx.sampled,
+                      span_ctx=ctx.span_id, **span_args)
+            if client is not None:
+                kw["parent_ctx"] = client.span_id
+            tid = self._tracer.track(f"http {ctx.span_id[:8]}")
+            ts = {"span": self._tracer.begin(
+                      "http_request", cat="frontdoor", tid=tid, **kw),
+                  "tid": tid}
+        return ctx, ts
+
+    def _tr_finish(self, ts, status=None, **args) -> None:
+        """Close the ``http_request`` root span — idempotent, called on
+        EVERY exit path of ``_generate`` (the engine suite pins
+        ``open_spans == 0`` after drain; the front door honors the same
+        no-leak contract)."""
+        if self._tracer is None or ts is None:
+            return
+        sid = ts.pop("span", None)
+        if sid is None:
+            return
+        self._tracer.end(sid, status=status, **args)
+
+    def _tr_shed(self, ts, code: int, error: str) -> None:
+        """Mark a 429/503 rejection: a terminal ``shed`` child span plus
+        ``status="shed"`` on the root — BOTH tail-sampler always-keep
+        triggers, so shed requests survive export even at
+        ``trace_sample_rate=0`` (satellite 6)."""
+        if self._tracer is None or ts is None:
+            return
+        sid = ts.get("span")
+        if sid is not None:
+            now = self._tracer.clock()
+            self._tracer.complete("shed", now, now, cat="frontdoor",
+                                  parent=sid, tid=ts.get("tid", 0),
+                                  code=code, error=error)
+        self._tr_finish(ts, status="shed", code=code)
+
+    def _remember_trace(self, rid, trace_id: str) -> None:
+        m = self._traced
+        m[str(rid)] = trace_id
+        while len(m) > _TRACED_CAP:
+            m.pop(next(iter(m)))
+
+    @staticmethod
+    def _trace_headers(rid, ctx) -> dict:
+        h = {}
+        if rid is not None:
+            h["X-Request-Id"] = str(rid)
+        if ctx is not None:
+            h["traceparent"] = ctx.to_traceparent()
+        return h
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -353,7 +473,13 @@ class FrontDoor:
                 await self._respond_json(writer, 405,
                                          {"error": "use GET /metrics"})
                 return
-            await self._metrics(writer)
+            await self._metrics(writer, headers)
+        elif target.startswith("/v1/requests/") and target.endswith("/trace"):
+            if method != "GET":
+                await self._respond_json(
+                    writer, 405, {"error": "use GET /v1/requests/{id}/trace"})
+                return
+            await self._request_trace(writer, target)
         elif target == "/v1/generate":
             if method != "POST":
                 await self._respond_json(writer, 405,
@@ -382,12 +508,40 @@ class FrontDoor:
         }
         await self._respond_json(writer, 200 if healthy else 503, body)
 
-    async def _metrics(self, writer) -> None:
-        # to_prometheus() serializes under the registry lock — the scrape
-        # is one atomic snapshot even while pumps are counting
-        text = self.registry.to_prometheus().encode("utf-8")
-        await self._respond_raw(writer, 200, text,
-                                content_type="text/plain; version=0.0.4")
+    async def _metrics(self, writer, headers: dict | None = None) -> None:
+        # to_prometheus()/to_openmetrics() serialize under the registry
+        # lock — the scrape is one atomic snapshot even while pumps are
+        # counting.  Content negotiation: an OpenMetrics Accept gets the
+        # exemplar-bearing exposition (trace ids on histogram buckets).
+        accept = (headers or {}).get("accept", "")
+        if "application/openmetrics-text" in accept:
+            text = self.registry.to_openmetrics().encode("utf-8")
+            ctype = ("application/openmetrics-text; "
+                     "version=1.0.0; charset=utf-8")
+        else:
+            text = self.registry.to_prometheus().encode("utf-8")
+            ctype = "text/plain; version=0.0.4"
+        await self._respond_raw(writer, 200, text, content_type=ctype)
+
+    async def _request_trace(self, writer, target: str) -> None:
+        """``GET /v1/requests/{id}/trace`` — the request's correlated
+        span tree (closed events + still-open spans) straight off the
+        tracer ring, keyed by the id the response echoed."""
+        rid = target[len("/v1/requests/"):-len("/trace")]
+        if self._tracer is None:
+            await self._respond_json(
+                writer, 503, {"error": "no tracer wired to this front door"})
+            return
+        trace_id = self._traced.get(rid)
+        if trace_id is None:
+            await self._respond_json(
+                writer, 404,
+                {"error": f"no trace recorded for request {rid!r}"})
+            return
+        events = self._tracer.trace_events(trace_id)
+        await self._respond_json(
+            writer, 200, {"request_id": rid, "trace_id": trace_id,
+                          "n_events": len(events), "events": events})
 
     async def _generate(self, reader, writer, headers: dict) -> None:
         self._bump("requests")
@@ -427,6 +581,13 @@ class FrontDoor:
         except asyncio.IncompleteReadError:
             return
 
+        # trace begin AFTER the body parsed (a malformed request never
+        # costs a span) and BEFORE admission — rejects are traced too
+        ctx, ts = self._trace_begin(headers, method="POST",
+                                    target="/v1/generate",
+                                    stream=spec["stream"])
+        client_rid = _sanitize_request_id(headers.get("x-request-id"))
+
         idem_key = headers.get("idempotency-key") or None
         last_event_id = None
         if "last-event-id" in headers:
@@ -434,6 +595,7 @@ class FrontDoor:
                 last_event_id = int(headers["last-event-id"])
             except ValueError:
                 self._bump("bad_requests")
+                self._tr_finish(ts, status="bad_request")
                 await self._respond_json(
                     writer, 400,
                     {"error": "Last-Event-ID must be an integer token index"})
@@ -448,6 +610,8 @@ class FrontDoor:
                     # a key names ONE request forever — reusing it with a
                     # different body is a client bug, not a new request
                     self._bump("idempotent_conflicts")
+                    self._tr_finish(ts, status="conflict",
+                                    request=bound_dr.id)
                     await self._respond_json(
                         writer, 422,
                         {"error": "Idempotency-Key already bound to a "
@@ -456,15 +620,20 @@ class FrontDoor:
                     return
                 # the retry binds to the ORIGINAL request: no second
                 # execution, the stream picks up wherever the client
-                # says it left off (Last-Event-ID)
+                # says it left off (Last-Event-ID).  The rebind's OWN
+                # http span closes here; the echoed traceparent is the
+                # original execution's trace — the one worth looking up
                 self._bump("idempotent_hits")
+                self._tr_finish(ts, status="rebind", request=bound_dr.id)
                 if spec["stream"]:
                     self._bump("streams")
                     self._bump("resumes")
                     await self._stream_resume(reader, writer, bound_dr,
-                                              last_event_id)
+                                              last_event_id,
+                                              rid=client_rid)
                 else:
-                    await self._collect_rebind(writer, bound_dr)
+                    await self._collect_rebind(writer, bound_dr,
+                                               rid=client_rid)
                 return
 
         loop = asyncio.get_running_loop()
@@ -474,28 +643,49 @@ class FrontDoor:
             # delivery thread → event loop: the ONE legal crossing
             loop.call_soon_threadsafe(events.put_nowait, ("tok", int(tok)))
 
+        # int-id parenting only works inside ONE tracer; a front door
+        # given its own tracer still joins through the hex ctx edges
+        tp_parent = (ts["span"] if ts is not None
+                     and self._tracer is getattr(self.daemon, "_tracer", None)
+                     else None)
         try:
             dr = self.daemon.submit(
                 spec["prompt"], spec["max_new"], callback=on_token,
                 deadline_s=spec["deadline_s"], priority=spec["priority"],
                 ttft_slo_s=spec["ttft_slo_s"], tpot_slo_s=spec["tpot_slo_s"],
-                sampling=spec["sampling"], idempotency_key=idem_key)
+                sampling=spec["sampling"], idempotency_key=idem_key,
+                trace_ctx=ctx, trace_parent=tp_parent)
         except SLOUnmeetable as e:
             self._bump("rejected_503")
-            await self._respond_reject(writer, 503, e)
+            self._tr_shed(ts, 503, str(e))
+            await self._respond_reject(writer, 503, e,
+                                       trace=self._trace_headers(
+                                           client_rid, ctx))
             return
         except QueueFull as e:
             self._bump("rejected_429")
-            await self._respond_reject(writer, 429, e)
+            self._tr_shed(ts, 429, str(e))
+            await self._respond_reject(writer, 429, e,
+                                       trace=self._trace_headers(
+                                           client_rid, ctx))
             return
         except RuntimeError as e:       # daemon draining/closed
             self._bump("rejected_503")
-            await self._respond_json(writer, 503, {"error": str(e)})
+            self._tr_shed(ts, 503, str(e))
+            await self._respond_json(
+                writer, 503, {"error": str(e)},
+                extra_headers=self._trace_headers(client_rid, ctx))
             return
         except ValueError as e:         # engine-level validation
             self._bump("bad_requests")
+            self._tr_finish(ts, status="bad_request")
             await self._respond_json(writer, 400, {"error": str(e)})
             return
+        # the id the response echoes (client-supplied when valid) and
+        # the daemon id BOTH resolve through /v1/requests/{id}/trace
+        rid = client_rid if client_rid is not None else str(dr.id)
+        self._remember_trace(rid, ctx.trace_id)
+        self._remember_trace(dr.id, ctx.trace_id)
 
         # the delivery callback only ENQUEUES to this loop — receipt is
         # the drained socket write, so THIS side journals the delivered
@@ -522,15 +712,21 @@ class FrontDoor:
         try:
             if spec["stream"]:
                 self._bump("streams")
-                await self._stream_sse(writer, dr, events, disconnect)
+                await self._stream_sse(writer, dr, events, disconnect,
+                                       rid=rid)
             else:
-                await self._collect_json(writer, dr, events, disconnect)
+                await self._collect_json(writer, dr, events, disconnect,
+                                         rid=rid, ctx=ctx)
         finally:
             disconnect.cancel()
             end_task.cancel()
             with _swallow():
                 await asyncio.gather(end_task, disconnect,
                                      return_exceptions=True)
+            # the root span covers accept -> last byte written: close it
+            # here, after the stream/collect finished (or died), with
+            # the request's terminal verdict as the tail-keep signal
+            self._tr_finish(ts, status=dr.status, request=dr.id)
 
     async def _next_event(self, events: asyncio.Queue,
                           disconnect: asyncio.Task,
@@ -580,12 +776,19 @@ class FrontDoor:
         except Exception:
             self.daemon._count("journal_errors")
 
-    def _sse_head(self, dr) -> bytes:
-        return (b"HTTP/1.1 200 OK\r\n"
+    def _sse_head(self, dr, rid=None) -> bytes:
+        head = (b"HTTP/1.1 200 OK\r\n"
                 b"Content-Type: text/event-stream\r\n"
                 b"Cache-Control: no-cache\r\n"
                 b"Connection: close\r\n"
-                + f"X-Request-Id: {dr.id}\r\n\r\n".encode())
+                + f"X-Request-Id: {dr.id if rid is None else rid}\r\n"
+                .encode())
+        # streams echo traceparent too (satellite 2) — derived from the
+        # request itself so idempotent rebinds echo the ORIGINAL trace
+        tctx = getattr(dr, "trace_ctx", None)
+        if tctx is not None:
+            head += f"traceparent: {tctx.to_traceparent()}\r\n".encode()
+        return head + b"\r\n"
 
     @staticmethod
     def _sse_token(idx: int, token: int) -> bytes:
@@ -600,8 +803,9 @@ class FrontDoor:
         return (b"event: end\ndata: "
                 + json.dumps(terminal).encode() + b"\n\n")
 
-    async def _stream_sse(self, writer, dr, events, disconnect) -> None:
-        writer.write(self._sse_head(dr))
+    async def _stream_sse(self, writer, dr, events, disconnect,
+                          rid=None) -> None:
+        writer.write(self._sse_head(dr, rid=rid))
         idx = dr.resume_from   # 0 for every front-door-fresh request
         try:
             await writer.drain()
@@ -631,7 +835,8 @@ class FrontDoor:
         except (ConnectionResetError, BrokenPipeError):
             self._cancel_on_disconnect(dr)
 
-    async def _stream_resume(self, reader, writer, dr, last_event_id) -> None:
+    async def _stream_resume(self, reader, writer, dr, last_event_id,
+                             rid=None) -> None:
         """Serve an idempotent-retry SSE rebind by POLLING ``dr.tokens``
         growth (list append is atomic; the single-slot delivery callback
         belongs to the original connection, so a rebind cannot ride the
@@ -639,7 +844,7 @@ class FrontDoor:
         sent one, else at the earliest token this process can serve
         (``dr.resume_from`` — pre-crash tokens below it were delivered
         to, and journaled against, the pre-crash stream)."""
-        writer.write(self._sse_head(dr))
+        writer.write(self._sse_head(dr, rid=rid))
         start = dr.resume_from if last_event_id is None else last_event_id + 1
         idx = max(start, dr.resume_from)
         disconnect = asyncio.ensure_future(reader.read(1))
@@ -678,7 +883,7 @@ class FrontDoor:
             with _swallow():
                 await disconnect
 
-    async def _collect_rebind(self, writer, dr) -> None:
+    async def _collect_rebind(self, writer, dr, rid=None) -> None:
         """Unary idempotent retry: wait out the ORIGINAL request and
         return its verdict — one execution, however many retries."""
         loop = asyncio.get_running_loop()
@@ -686,11 +891,16 @@ class FrontDoor:
         body = {"id": dr.id, "status": dr.status, "error": dr.error,
                 "tokens": list(dr.tokens), "resume_from": dr.resume_from}
         try:
-            await self._respond_json(writer, 200, body)
+            await self._respond_json(
+                writer, 200, body,
+                extra_headers=self._trace_headers(
+                    dr.id if rid is None else rid,
+                    getattr(dr, "trace_ctx", None)))
         except (ConnectionResetError, BrokenPipeError):
             self._bump("disconnects")
 
-    async def _collect_json(self, writer, dr, events, disconnect) -> None:
+    async def _collect_json(self, writer, dr, events, disconnect,
+                            rid=None, ctx=None) -> None:
         while True:
             kind, _payload = await self._next_event(events, disconnect)
             if kind == "end":
@@ -704,26 +914,31 @@ class FrontDoor:
         body = {"id": dr.id, "status": dr.status, "error": dr.error,
                 "tokens": list(dr.tokens)}
         try:
-            await self._respond_json(writer, 200, body)
+            await self._respond_json(
+                writer, 200, body,
+                extra_headers=self._trace_headers(
+                    dr.id if rid is None else rid, ctx))
         except (ConnectionResetError, BrokenPipeError):
             self._bump("disconnects")
 
     # ------------------------------------------------------------------
     # response plumbing
 
-    async def _respond_reject(self, writer, code: int, exc: QueueFull) -> None:
+    async def _respond_reject(self, writer, code: int, exc: QueueFull,
+                              trace: dict | None = None) -> None:
         """429/503 with the policy's backoff hint as a real Retry-After
         header (integer seconds, ceil — never rounded to an instant
-        retry) AND machine-readable in the body."""
+        retry) AND machine-readable in the body; ``trace`` carries the
+        X-Request-Id/traceparent echo so a shed request is findable."""
         hint = getattr(exc, "retry_after_s", None)
-        extra = None
+        extra = dict(trace or {})
         if hint is not None:
-            extra = {"Retry-After": str(max(1, math.ceil(hint)))}
+            extra["Retry-After"] = str(max(1, math.ceil(hint)))
         await self._respond_json(
             writer, code,
             {"error": str(exc),
              "retry_after_s": None if hint is None else round(float(hint), 6)},
-            extra_headers=extra)
+            extra_headers=extra or None)
 
     async def _respond_json(self, writer, code: int, body: dict,
                             extra_headers: dict | None = None) -> None:
@@ -828,20 +1043,24 @@ class FrontDoorClient:
         return h
 
     def generate(self, prompt, max_new: int, *,
-                 idempotency_key: str | None = None, **kw) -> dict:
+                 idempotency_key: str | None = None,
+                 extra_headers: dict | None = None, **kw) -> dict:
         """POST /v1/generate, non-streaming; returns the JSON body (the
         ``tokens`` list on 200, the error + ``retry_after_s`` on 4xx/5xx;
         check :attr:`last_status`).  ``idempotency_key`` makes the call
         safe to re-issue after a connection reset: the retry binds to
-        the original execution."""
+        the original execution.  ``extra_headers`` rides along verbatim
+        (``X-Request-Id``, ``traceparent``, ...)."""
         payload = {"prompt": [int(t) for t in prompt],
                    "max_new": int(max_new), **kw}
-        return self._json_call("POST", "/v1/generate", payload,
-                               self._retry_headers(idempotency_key, None))
+        send = self._retry_headers(idempotency_key, None)
+        send.update(extra_headers or {})
+        return self._json_call("POST", "/v1/generate", payload, send)
 
     def stream(self, prompt, max_new: int, *,
                idempotency_key: str | None = None,
-               last_event_id: int | None = None, **kw) -> Iterator[int]:
+               last_event_id: int | None = None,
+               extra_headers: dict | None = None, **kw) -> Iterator[int]:
         """POST /v1/generate with ``stream: true``; yields each token as
         its SSE event arrives.  On a non-200 the rejection body lands in
         :attr:`last_terminal` and nothing is yielded.  Each event's
@@ -852,9 +1071,9 @@ class FrontDoorClient:
                    "max_new": int(max_new), "stream": True, **kw}
         self.last_terminal = None
         self.last_event_id = None if last_event_id is None else int(last_event_id)
-        conn, resp = self._request(
-            "POST", "/v1/generate", payload,
-            self._retry_headers(idempotency_key, last_event_id))
+        send = self._retry_headers(idempotency_key, last_event_id)
+        send.update(extra_headers or {})
+        conn, resp = self._request("POST", "/v1/generate", payload, send)
         try:
             if resp.status != 200:
                 raw = resp.read()
@@ -876,8 +1095,17 @@ class FrontDoorClient:
     def healthz(self) -> dict:
         return self._json_call("GET", "/healthz")
 
-    def metrics(self) -> str:
-        conn, resp = self._request("GET", "/metrics")
+    def request_trace(self, request_id) -> dict:
+        """GET /v1/requests/{id}/trace — the span tree the front door
+        recorded for ``request_id`` (client-supplied or daemon id)."""
+        return self._json_call("GET", f"/v1/requests/{request_id}/trace")
+
+    def metrics(self, accept: str | None = None) -> str:
+        """GET /metrics; pass ``accept="application/openmetrics-text"``
+        for the exemplar-bearing OpenMetrics exposition."""
+        conn, resp = self._request(
+            "GET", "/metrics",
+            headers=None if accept is None else {"Accept": accept})
         try:
             return resp.read().decode("utf-8")
         finally:

@@ -343,6 +343,10 @@ class RequestJournal:
             "key": dr.idempotency_key,
             "fp": dr.fingerprint,
             "resume_from": int(dr.resume_from),
+            # the W3C traceparent, so a post-crash replay CONTINUES the
+            # request's distributed trace instead of starting a new one
+            "tp": (dr.trace_ctx.to_traceparent()
+                   if getattr(dr, "trace_ctx", None) is not None else None),
             "wall_t": time.time(),
         })
 
@@ -639,6 +643,13 @@ def _submit_with_retry(daemon, meta, sampling, deadline, resume_from,
     from distributed_tensorflow_ibm_mnist_tpu.serving.scheduler import (
         QueueFull,
     )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceContext,
+    )
+    # the crashed process's traceparent: the replay CONTINUES that trace
+    # (same trace id; this hop parents under the journaled span via the
+    # parent_ctx hex edge in the merged export)
+    trace_ctx = TraceContext.parse_traceparent(meta.get("tp"))
     give_up = time.monotonic() + timeout_s
     while True:
         try:
@@ -649,7 +660,8 @@ def _submit_with_retry(daemon, meta, sampling, deadline, resume_from,
                 tpot_slo_s=meta.get("tpot_slo_s"),
                 sampling=sampling,
                 idempotency_key=meta.get("key"),
-                resume_from=int(resume_from))
+                resume_from=int(resume_from),
+                trace_ctx=trace_ctx)
         except QueueFull:
             if time.monotonic() >= give_up:
                 raise

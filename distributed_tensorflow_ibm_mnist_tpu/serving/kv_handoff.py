@@ -83,6 +83,10 @@ class HandoffPacket:
     created_t: float
     gather_s: float
     payload_bytes: int
+    trace_ctx: Any = None            # distributed TraceContext riding the
+    #   handoff: the prefill-role and decode-role spans of one request
+    #   join under trace_ctx.trace_id even when the roles run on separate
+    #   tracers (the merged export connects them via span_ctx/parent_ctx)
 
     def release(self) -> None:
         """Free the source-side hold — called by the router exactly when
@@ -144,7 +148,8 @@ def package(engine, req, slot: int, logits_dev, bt_row) -> "HandoffPacket":
     return HandoffPacket(req=req, n_tok=n_tok, payloads=payloads,
                          last_logits=last, source=engine, hold=hold,
                          created_t=t0, gather_s=t1 - t0,
-                         payload_bytes=int(nbytes))
+                         payload_bytes=int(nbytes),
+                         trace_ctx=req.trace_ctx)
 
 
 def deliver(engine, packet: "HandoffPacket") -> bool:
@@ -235,7 +240,9 @@ def deliver(engine, packet: "HandoffPacket") -> bool:
                 req.first_token_t - req.submit_t <= req.ttft_slo_s)
         if engine._telemetry is not None:
             engine._telemetry.observe(
-                "ttft_s", req.first_token_t - req.submit_t)
+                "ttft_s", req.first_token_t - req.submit_t,
+                exemplar=(packet.trace_ctx.trace_id
+                          if packet.trace_ctx is not None else None))
             engine._telemetry.inc("tokens_generated")
         req.status = "running"
         engine._tr_phase(req, "decode", slot=slot, handoff=True)

@@ -124,7 +124,8 @@ class RouterRequest:
                  callback: Callable | None,
                  ttft_slo_s: float | None = None,
                  tpot_slo_s: float | None = None,
-                 sampling=None, resume_from: int = 0):
+                 sampling=None, resume_from: int = 0,
+                 trace_ctx=None, trace_parent: int | None = None):
         self.id = rid
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.max_new = int(max_new)
@@ -161,6 +162,16 @@ class RouterRequest:
         # request (deadline lapsed between attempts, no replica left)
         self.final_status: str | None = None
         self.final_error: str | None = None
+        # distributed tracing: the W3C TraceContext this request carries
+        # (None for untraced callers) and the span id — in the SHARED
+        # tier tracer — that each attempt's engine span should parent
+        # under (the daemon's per-request root).  ``_last_attempt_span``
+        # is the previous attempt's engine span id: a failover replay
+        # attaches it as a span LINK, so the replay reads as a
+        # continuation of the original attempt, not a silent restart.
+        self.trace_ctx = trace_ctx
+        self.trace_parent = trace_parent
+        self._last_attempt_span: int | None = None
 
     @property
     def status(self) -> str:
@@ -308,7 +319,9 @@ class Router:
                callback: Callable | None = None,
                ttft_slo_s: float | None = None,
                tpot_slo_s: float | None = None,
-               sampling=None, resume_from: int = 0) -> RouterRequest:
+               sampling=None, resume_from: int = 0,
+               trace_ctx=None, trace_parent: int | None = None
+               ) -> RouterRequest:
         """Place one request on the least-loaded healthy replica.  Raises
         :class:`NoHealthyReplica` when no replica can be tried and
         :class:`QueueFull` when every healthy replica's queue is at bound
@@ -319,7 +332,11 @@ class Router:
         so a failover replay consumes the same seed.  ``resume_from``
         (crash recovery — serving/journal.py) seeds the delivered
         high-water mark: the first attempt regenerates the whole stream
-        but only tokens past the mark reach ``callback``."""
+        but only tokens past the mark reach ``callback``.  ``trace_ctx``
+        (utils/tracing.TraceContext) joins every attempt's engine spans
+        into the request's distributed trace; ``trace_parent`` is the
+        caller's span id in the SHARED tier tracer (the attempt spans
+        re-parent under it)."""
         if self._closed:
             raise RuntimeError("router is closed")
         if resume_from < 0:
@@ -327,7 +344,8 @@ class Router:
         rr = RouterRequest(next(self._ids), prompt, max_new, deadline_s,
                            self.clock(), callback,
                            ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s,
-                           sampling=sampling, resume_from=resume_from)
+                           sampling=sampling, resume_from=resume_from,
+                           trace_ctx=trace_ctx, trace_parent=trace_parent)
         self._dispatch(rr)   # propagates QueueFull / NoHealthyReplica
         self.requests.append(rr)
         return rr
@@ -436,6 +454,22 @@ class Router:
             rr.attempts.append((rep.index, req))
             rr._attempt_delivered = 0
             self._owner[id(req)] = rr
+            if rr.trace_ctx is not None:
+                # distributed trace join: stamp the context on the engine
+                # attempt (exemplars + handoff packets read it) and claim
+                # the engine's request span for the trace — re-parented
+                # under the daemon's span, replays LINKED to the attempt
+                # they replace (not silent restarts)
+                req.trace_ctx = rr.trace_ctx
+                if self._tracer is not None and req.trace is not None:
+                    prior = rr._last_attempt_span
+                    self._tracer.annotate(
+                        req.trace["id"], parent=rr.trace_parent,
+                        links=[prior] if prior is not None else None,
+                        trace=rr.trace_ctx.trace_id,
+                        sampled=rr.trace_ctx.sampled,
+                        attempt=len(rr.attempts), replica=rep.index)
+                    rr._last_attempt_span = req.trace["id"]
             return
 
     # ------------------------------------------------------------------
@@ -566,12 +600,18 @@ class Router:
                 if rr is not None:
                     rr.replica = dest.index
                 if self._tracer is not None:
+                    kw = {}
+                    t = getattr(packet.req, "trace", None)
+                    if t is not None:
+                        kw["parent"] = t["id"]
+                    if packet.trace_ctx is not None:
+                        kw["trace"] = packet.trace_ctx.trace_id
                     self._tracer.instant(
                         "handoff_delivered", cat="router", tid=dest.tid,
                         request=getattr(packet.req, "id", None),
                         source=rep.index, replica=dest.index,
                         pages=len(packet.payloads),
-                        bytes=packet.payload_bytes)
+                        bytes=packet.payload_bytes, **kw)
         return delivered
 
     def _handoff_fault(self, rep: Replica, packet, rr: RouterRequest | None,
@@ -667,10 +707,16 @@ class Router:
                 self._orphans.append(rr)
                 continue
             if self._tracer is not None and rr.replica is not None:
+                kw = {}
+                t = getattr(rr.req, "trace", None)
+                if t is not None:
+                    kw["parent"] = t["id"]
+                if rr.trace_ctx is not None:
+                    kw["trace"] = rr.trace_ctx.trace_id
                 self._tracer.instant(
                     "failover_redispatch", cat="router",
                     tid=self.replicas[rr.replica].tid, request=rr.id,
-                    source=rep.index, replica=rr.replica)
+                    source=rep.index, replica=rr.replica, **kw)
 
     def _retry_orphans(self) -> None:
         still: list[RouterRequest] = []

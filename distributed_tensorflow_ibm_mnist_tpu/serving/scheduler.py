@@ -153,6 +153,11 @@ class Request:
     #   {"id": request span, "tid": the request's track, "phase": the open
     #   lifecycle-phase span (queue/admit/decode) or None}; None when no
     #   tracer is wired — every touch is nil-guarded like the chaos hooks
+    trace_ctx: "object | None" = None   # distributed TraceContext
+    #   (utils/tracing.TraceContext) stamped by the ROUTER after submit —
+    #   the engine never parses trace headers; it just carries the context
+    #   so the handoff packet and the telemetry exemplars can read
+    #   trace_ctx.trace_id.  None for direct engine callers.
 
     @property
     def overdue_at(self) -> float:

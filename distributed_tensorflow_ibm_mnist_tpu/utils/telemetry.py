@@ -112,6 +112,20 @@ class HistogramSketch:
                 i = self.n_buckets - 1
             self.counts[i] += 1
 
+    def bucket_index(self, value: float) -> int | None:
+        """Which bucket ``value`` would land in: ``-1`` for underflow,
+        ``n_buckets`` for overflow, None for non-finite.  The key the
+        exemplar store shares with the exposition renderers."""
+        v = float(value)
+        if not math.isfinite(v):
+            return None
+        if v < self.lo:
+            return -1
+        if v >= self.hi:
+            return self.n_buckets
+        i = int(math.log(v / self.lo) / self._log_growth)
+        return min(i, self.n_buckets - 1)
+
     def _same_config(self, other: "HistogramSketch") -> bool:
         return (self.lo == other.lo and self.hi == other.hi
                 and self.growth == other.growth)
@@ -215,6 +229,13 @@ class RollingHistogram:
     ring plus the open interval, so its percentiles cover exactly the
     last ``window`` sampling intervals — rolling p50/p95/p99 with no
     stored samples and memory fixed at ``(window + 1) * O(buckets)``.
+
+    Exemplars (OpenMetrics): ``record(v, exemplar="<trace id>")`` keeps,
+    per lifetime bucket, the LAST exemplar'd observation that landed
+    there — ``(trace_id, value, unix_t)`` — so a scrape of a latency
+    histogram carries a recent trace id for each populated bucket and a
+    p99 outlier becomes a one-click jump into its distributed trace.
+    Memory is one tuple per bucket, regardless of traffic.
     """
 
     def __init__(self, window: int = 8, **sketch_kw):
@@ -225,10 +246,16 @@ class RollingHistogram:
         self.lifetime = HistogramSketch(**sketch_kw)
         self._cur = HistogramSketch(**sketch_kw)
         self._ring: deque[HistogramSketch] = deque(maxlen=self.window - 1)
+        self.exemplars: dict[int, tuple[str, float, float]] = {}
 
-    def record(self, value: float) -> None:
+    def record(self, value: float, exemplar: str | None = None) -> None:
         self.lifetime.record(value)
         self._cur.record(value)
+        if exemplar is not None:
+            i = self.lifetime.bucket_index(value)
+            if i is not None:
+                self.exemplars[i] = (str(exemplar), float(value),
+                                     time.time())
 
     def rotate(self) -> None:
         if self.window > 1:
@@ -294,13 +321,14 @@ class MetricsRegistry:
         with self._lock:
             self.gauges[name] = value
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float,
+                exemplar: str | None = None) -> None:
         with self._lock:
             h = self.histograms.get(name)
             if h is None:
                 h = self.histograms[name] = RollingHistogram(
                     window=self._window, **self._sketch_kw)
-            h.record(value)
+            h.record(value, exemplar=exemplar)
 
     def rotate(self) -> None:
         with self._lock:
@@ -404,6 +432,71 @@ class MetricsRegistry:
             lines.append(f"{m}_count {s.count}")
         return "\n".join(lines) + "\n"
 
+    def to_openmetrics(self, prefix: str = "dtm",
+                       extra_gauges: dict | None = None,
+                       exemplar_label: str = "trace_id") -> str:
+        """OpenMetrics 1.0 text exposition — same data as
+        :meth:`to_prometheus` plus EXEMPLARS: each populated histogram
+        bucket that has a recorded exemplar carries
+        ``# {trace_id="<id>"} <value> <unix_t>`` after its count, which
+        is how a scraper (and Grafana) jump from a latency bucket to the
+        distributed trace of a request that landed in it.  Counters get
+        the spec's ``_total`` suffix; the exposition ends with ``# EOF``.
+        Serve it for ``Accept: application/openmetrics-text``.
+        """
+        with self._lock:
+            return self._to_openmetrics_locked(prefix, extra_gauges,
+                                               exemplar_label)
+
+    def _to_openmetrics_locked(self, prefix, extra_gauges, exemplar_label):
+        lines: list[str] = []
+
+        def ex(tup) -> str:
+            if tup is None:
+                return ""
+            eid, value, unix_t = tup
+            return (f' # {{{exemplar_label}="{eid}"}} {value:.6g}'
+                    f" {unix_t:.3f}")
+
+        for name in sorted(self.counters):
+            m = f"{prefix}_{_prom_name(name)}"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m}_total {self.counters[name]}")
+        gauges = dict(self.gauges)
+        if extra_gauges:
+            gauges.update(extra_gauges)
+        for name in sorted(gauges):
+            v = gauges[name]
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                continue
+            m = f"{prefix}_{_prom_name(name)}"
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {v}")
+        for name in sorted(self.histograms):
+            h = self.histograms[name]
+            s = h.lifetime
+            m = f"{prefix}_{_prom_name(name)}"
+            lines.append(f"# TYPE {m} histogram")
+            cum = s.underflow
+            for i, c in enumerate(s.counts):
+                if c == 0:
+                    continue
+                cum += c
+                le = s.lo * s.growth ** (i + 1)
+                exemplar = h.exemplars.get(i)
+                if exemplar is None and cum == s.underflow + c:
+                    exemplar = h.exemplars.get(-1)  # underflow folds here
+                lines.append(f'{m}_bucket{{le="{le:.6g}"}} {cum}'
+                             f"{ex(exemplar)}")
+            lines.append(f'{m}_bucket{{le="+Inf"}} {s.count}'
+                         f"{ex(h.exemplars.get(s.n_buckets))}")
+            lines.append(f"{m}_sum {round(s.sum, 9)}")
+            lines.append(f"{m}_count {s.count}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
 
 class Telemetry:
     """The health sampler: interval-gated vitals snapshots to JSONL +
@@ -472,8 +565,9 @@ class Telemetry:
     def set_gauge(self, name: str, value) -> None:
         self.registry.set_gauge(name, value)
 
-    def observe(self, name: str, value: float) -> None:
-        self.registry.observe(name, value)
+    def observe(self, name: str, value: float,
+                exemplar: str | None = None) -> None:
+        self.registry.observe(name, value, exemplar=exemplar)
 
     def heartbeat(self, name: str) -> None:
         """Stamp ``{name}_heartbeat_t`` with the sampler clock — the

@@ -25,6 +25,13 @@ the protocol layer, end to end through real sockets:
    and tier counters together; every leg drains to ``open_spans == 0``
    and refcount-zero pools (a wire client is not allowed to leak a slot,
    a page, or a span).
+5. **tracing** (ISSUE 19) — with distributed tracing ON, the
+   instrumentation's self-measured share of unary HTTP wall stays
+   within 2% (every tracer entry point timer-wrapped — same
+   methodology as bench_tracing's overhead leg), ``traceparent`` is
+   echoed on the wire, and at ``trace_sample_rate=0.0`` shed (429/503)
+   requests are still tail-kept in the export with a terminal ``shed``
+   span while 200s are head-dropped.
 
 Usage:  JAX_PLATFORMS=cpu python scripts/bench_frontdoor.py
 Emits one JSON line (``"metric": "frontdoor"``); exits nonzero when any
@@ -35,6 +42,7 @@ smoke.  bench.py runs this as its ``frontdoor`` block
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -57,6 +65,8 @@ MAX_NEW = 4
 N_PARITY = 3 if QUICK else 6
 N_CHAOS = 6 if QUICK else 12
 N_FLOOD = 8 if QUICK else 16
+N_TRACE = 4 if QUICK else 8
+N_TWAVES = 3 if QUICK else 6
 WAIT_S = 120.0
 
 
@@ -67,7 +77,7 @@ def _mk_prompts(seed: int, n: int):
 
 
 def _build(chaos=None, tracer=None, n_replicas=2, max_queue=64,
-           policy=None):
+           policy=None, trace_sample_rate=None):
     from distributed_tensorflow_ibm_mnist_tpu.models import get_model
     from distributed_tensorflow_ibm_mnist_tpu.serving import (
         FIFOScheduler,
@@ -91,7 +101,9 @@ def _build(chaos=None, tracer=None, n_replicas=2, max_queue=64,
     router.prewarm()
     daemon = ServingDaemon(router, max_queue=max_queue, policy=policy,
                            liveness_timeout_s=30.0).start()
-    fd = FrontDoor(daemon).start_in_thread()
+    fd_kw = ({} if trace_sample_rate is None
+             else {"trace_sample_rate": trace_sample_rate})
+    fd = FrontDoor(daemon, **fd_kw).start_in_thread()
     return daemon, fd
 
 
@@ -275,11 +287,135 @@ def leg_backpressure() -> dict:
     return out
 
 
+def leg_tracing() -> dict:
+    """Distributed tracing ON, measured on the wire (ISSUE 19).
+
+    Overhead: paired wall deltas cannot resolve 2% on a shared CPU box,
+    so — like bench_tracing's overhead leg — every tracer entry point is
+    wrapped with a timer and the gated number is total tracing time over
+    total unary-HTTP wall (conservative: the wrapper's own cost counts
+    as tracing).  Shed: against a tiny admission bound with
+    ``trace_sample_rate=0.0``, rejected (429/503) requests must still be
+    in the export — tail-kept via their terminal ``shed`` span — while
+    successful 200s are head-dropped."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        DeadlineAwarePolicy,
+        FrontDoorClient,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceContext,
+        Tracer,
+        trace_forest,
+    )
+
+    # -- overhead: instrumentation share of unary HTTP wall
+    tracer = Tracer()
+    spent = {"s": 0.0}
+
+    def timed(fn):
+        def wrapped(*a, **k):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                spent["s"] += time.perf_counter() - t0
+        return wrapped
+
+    for name in ("begin", "end", "complete", "instant", "annotate",
+                 "track"):
+        setattr(tracer, name, timed(getattr(tracer, name)))
+    daemon, fd = _build(tracer=tracer)
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    prompts = _mk_prompts(25, N_TRACE)
+
+    def wave() -> float:
+        t0 = time.perf_counter()
+        for p in prompts:
+            cli.generate(p, MAX_NEW)
+        return time.perf_counter() - t0
+
+    wave()
+    wave()                       # warm: compile, pools, socket path
+    tp_echoed = TraceContext.parse_traceparent(
+        (cli.last_headers or {}).get("traceparent")) is not None
+    spent["s"] = 0.0
+    gc.collect()                 # a gen2 pause inside a wrapped call
+    gc.disable()                 # would read as tracing time
+    try:
+        walls = [wave() for _ in range(N_TWAVES)]
+    finally:
+        gc.enable()
+    share = spent["s"] / sum(walls)
+    down_a = _teardown(daemon, fd)
+    open_a = tracer.open_spans
+
+    # -- shed tail-keep at sample rate zero
+    tracer2 = Tracer()
+    policy = DeadlineAwarePolicy(concurrency=4)
+    daemon, fd = _build(tracer=tracer2, max_queue=3, policy=policy,
+                        trace_sample_rate=0.0)
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    warm = cli.generate(_mk_prompts(26, 1)[0], MAX_NEW)
+    warm_ok = cli.last_status == 200 and warm.get("status") == "done"
+    hits: list[tuple[int, str | None]] = []
+    lock = threading.Lock()
+
+    def flooder(prompt):
+        c = FrontDoorClient("127.0.0.1", fd.port, timeout=WAIT_S)
+        c.generate(prompt, MAX_NEW, deadline_s=WAIT_S)
+        with lock:
+            hits.append((c.last_status,
+                         (c.last_headers or {}).get("traceparent")))
+
+    threads = [threading.Thread(target=flooder, args=(p,))
+               for p in _mk_prompts(27, N_FLOOD)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=WAIT_S)
+    deadline = time.monotonic() + WAIT_S
+    while time.monotonic() < deadline:
+        if daemon.conservation()["outstanding"] == 0:
+            break
+        time.sleep(0.02)
+    down_b = _teardown(daemon, fd)
+    kept = trace_forest(tracer2.to_doc(sampler=fd.sampler))
+    shed_tids = [TraceContext.parse_traceparent(tp).trace_id
+                 for s, tp in hits if s in (429, 503) and tp]
+    ok_tids = [TraceContext.parse_traceparent(tp).trace_id
+               for s, tp in hits if s == 200 and tp]
+    shed_kept = all(t in kept and "shed" in kept[t]["names"]
+                    for t in shed_tids)
+    ok_dropped = all(t not in kept for t in ok_tids)
+    return {
+        "waves": N_TWAVES, "requests_per_wave": len(prompts),
+        "wall_min_s": round(min(walls), 4),
+        "tracing_share": round(share, 4),
+        "traceparent_echoed": tp_echoed,
+        "warm_ok": warm_ok,
+        "flood": len(hits),
+        "shed_on_wire": len(shed_tids),
+        "shed_kept": shed_kept,
+        "ok_dropped_at_rate0": ok_dropped,
+        "open_spans": open_a + tracer2.open_spans,
+        "drained_clean": down_a["drained_clean"] and down_b["drained_clean"],
+        "pools_zero": down_a["pools_zero"] and down_b["pools_zero"],
+    }
+
+
 def main() -> None:
     parity = leg_parity()
     chaos = leg_chaos()
     backpressure = leg_backpressure()
+    tracing = leg_tracing()
     gates = {
+        "tracing_overhead_le_2pct": tracing["tracing_share"] <= 0.02,
+        "tracing_traceparent_on_wire": tracing["traceparent_echoed"],
+        "tracing_shed_tail_kept": tracing["shed_on_wire"] >= 1
+        and tracing["shed_kept"],
+        "tracing_ok_dropped_at_rate0": tracing["warm_ok"]
+        and tracing["ok_dropped_at_rate0"],
+        "tracing_no_open_spans": tracing["open_spans"] == 0,
         "wire_parity": parity["parity"] and parity["compared"] >= 2,
         "chaos_failover_happened": chaos["failovers"] >= 1
         and chaos["pump_faults"] >= 1,
@@ -296,7 +432,8 @@ def main() -> None:
         "one_scrape_both_worlds": backpressure["metrics_has_frontdoor"]
         and backpressure["metrics_has_rejects"],
         "drained_clean": all(l["drained_clean"] and l["pools_zero"]
-                             for l in (parity, chaos, backpressure)),
+                             for l in (parity, chaos, backpressure,
+                                       tracing)),
     }
     record = {
         "metric": "frontdoor",
@@ -304,6 +441,7 @@ def main() -> None:
         "parity": parity,
         "chaos": chaos,
         "backpressure": backpressure,
+        "tracing": tracing,
         "gates": gates,
         "passed": all(gates.values()),
     }

