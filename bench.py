@@ -35,7 +35,13 @@ scripts/bench_speculative.py, skip with DTM_BENCH_SKIP_SPEC), and a
 per-chip bytes pinned at 1/tp, the dense/paged x int8 x decode_ahead x
 speculative parity cross token-identical across tp, a failover replay
 over disjoint tp groups — scripts/bench_tp_serving.py, skip with
-DTM_BENCH_SKIP_TP), and a ``train_census`` block (ROADMAP 5a: per-path
+DTM_BENCH_SKIP_TP), and a ``cp_serving`` block (ISSUE 20:
+context-parallel serving at cp ∈ {1,2,4} — sequence-sharded paged KV
+pinned at 1/cp per chip, a long prompt over the synthetic single-chip
+budget served to greedy + seeded-sampled parity vs cp=1, the
+cp-qualified compile census, and cp-invariant chaos event counts —
+scripts/bench_cp_serving.py, skip with DTM_BENCH_SKIP_CP), and a
+``train_census`` block (ROADMAP 5a: per-path
 pinned compile budgets for Trainer.fit()'s program family —
 scripts/bench_train_census.py, skip with DTM_BENCH_SKIP_TRAIN_CENSUS),
 and a ``quant`` block (ISSUE 12: weight-only int8 decode — the
@@ -70,9 +76,9 @@ replayed into a fresh tier, and clients stitch exactly-once transcripts
 across the crash; gates zero lost accepted requests, zero duplicated
 tokens, token parity with an uncrashed reference, steady-state journal
 overhead <=2%, and torn-tail recovery — scripts/bench_crash.py, skip
-with DTM_BENCH_SKIP_CRASH).  The tp_serving, train_census, quant,
-sampling, slo_daemon, disagg, frontdoor, crash, and serving-subprocess
-gates (compile census budgets, the ISSUE 11 telemetry <=2% overhead
+with DTM_BENCH_SKIP_CRASH).  The tp_serving, cp_serving, train_census,
+quant, sampling, slo_daemon, disagg, frontdoor, crash, and
+serving-subprocess gates (compile census budgets, the ISSUE 11 telemetry <=2% overhead
 bar, SLO/goodput counter arithmetic) fail the bench run (exit 3) on
 breach, after the record prints.
 
@@ -452,6 +458,53 @@ def main() -> None:
 
             tp_gate_rc = 1
             print(f"bench: tp_serving phase failed: {e!r}", file=sys.stderr)
+
+    # Phase 5c2 — context-parallel serving (ISSUE 20): sequence-sharded
+    # paged KV over a cp×tp mesh — per-chip KV bytes pinned at 1/cp at a
+    # FIXED pool size, a long prompt exceeding the synthetic single-chip
+    # budget served to exact greedy + seeded-sampled parity vs the cp=1
+    # reference, the cp-qualified compile census (cold budget, zero
+    # post-prewarm programs), and cp-invariant chaos event counts through
+    # a disagg handoff tier.  Runs scripts/bench_cp_serving.py in a
+    # SUBPROCESS on an 8-device virtual CPU platform.  Skippable
+    # (DTM_BENCH_SKIP_CP); any gate breach FAILS the bench run (exit 3)
+    # after the record prints.
+    cp_serving = None
+    cp_gate_rc = 0
+    if not os.environ.get("DTM_BENCH_SKIP_CP"):
+        try:
+            import subprocess
+            import sys
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)  # the script arms its own devices
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "bench_cp_serving.py")],
+                capture_output=True, text=True, timeout=580, env=env,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") == "cp_serving":
+                    cp_serving = rec
+            if cp_serving is None or out.returncode != 0:
+                cp_gate_rc = out.returncode or 1
+                print(
+                    f"bench: cp_serving subprocess "
+                    f"{'produced no record' if cp_serving is None else 'FAILED (census/memory/parity/chaos gate)'} "
+                    f"(rc={out.returncode}); stderr tail: {out.stderr[-500:]!r}",
+                    file=sys.stderr,
+                )
+        except Exception as e:
+            import sys
+
+            cp_gate_rc = 1
+            print(f"bench: cp_serving phase failed: {e!r}", file=sys.stderr)
 
     # Phase 5d — quantized decode compute (ISSUE 12): weight-only int8
     # matmuls with fused dequant, measured two ways by scripts/
@@ -1035,6 +1088,10 @@ def main() -> None:
         result["tp_serving"] = {
             k: v for k, v in tp_serving.items() if k != "metric"
         }
+    if cp_serving is not None:
+        result["cp_serving"] = {
+            k: v for k, v in cp_serving.items() if k != "metric"
+        }
     if train_census is not None:
         result["train_census"] = {
             k: v for k, v in train_census.items() if k != "metric"
@@ -1079,9 +1136,10 @@ def main() -> None:
     # serving: compile budgets + telemetry overhead + SLO/goodput
     # arithmetic) fail the RUN, not just their block — after the record
     # prints so the numbers are never lost with the verdict
-    if (tp_gate_rc or census_gate_rc or serving_gate_rc or quant_gate_rc
-            or sampling_gate_rc or chunked_gate_rc or slo_gate_rc
-            or disagg_gate_rc or frontdoor_gate_rc or crash_gate_rc):
+    if (tp_gate_rc or cp_gate_rc or census_gate_rc or serving_gate_rc
+            or quant_gate_rc or sampling_gate_rc or chunked_gate_rc
+            or slo_gate_rc or disagg_gate_rc or frontdoor_gate_rc
+            or crash_gate_rc):
         import sys
 
         sys.exit(3)

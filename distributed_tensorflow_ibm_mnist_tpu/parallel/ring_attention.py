@@ -78,34 +78,47 @@ def vanilla_attention(q, k, v, causal: bool = False, window: int = 0):
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     """shard_map body: local (B, S_local, H, D) shards of a sharded sequence.
 
-    GQA note: the dense inner expands K/V groups up front (and so rotates
-    the expanded copies around the ring); the flash inner keeps K/V at
-    H_kv and lets the kernel's index maps do the routing — prefer it when
-    bandwidth matters."""
+    GQA note: K/V stay at their native H_kv width through the ring — the
+    rotating blocks carry H_kv heads, never the H-expanded copies, so a
+    GQA config pays H_kv/H of the MHA per-hop bytes.  Scores are computed
+    grouped (q reshaped to (B, S, H_kv, G, D)); the contraction touches
+    the same numbers in the same order as ``_expand_kv_groups`` + MHA
+    einsum would, so the grouped path is bit-identical to the expanded
+    form it replaced."""
     dtype = q.dtype
-    k, v = _expand_kv_groups(q, k, v)
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of k/v heads "
+            f"({k.shape[2]})"
+        )
+    grp = q.shape[2] // k.shape[2]
     q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
+    hkv = k.shape[2]
     scale = d**-0.5
+    qg = q.reshape(b, s_local, hkv, grp, d)
 
     q_pos = my * s_local + jnp.arange(s_local)  # global query positions
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def block_update(carry_kv, src, m, l, o):
         k_blk, v_blk = carry_kv
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+        # grouped scores (B, H_kv, G, S_q, S_k); G == 1 is the MHA case
+        # with a size-1 group axis.
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_blk) * scale
         if causal:
             k_pos = src * s_local + jnp.arange(s_local)
             mask = q_pos[:, None] >= k_pos[None, :]  # (S_q, S_k)
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
         p = jnp.exp(scores - m_safe[..., None])  # masked entries -> exp(-inf) = 0
         corr = jnp.exp(m - m_safe)  # first block: exp(-inf) = 0 zeroes the empty accum
         l_new = l * corr + p.sum(axis=-1)
-        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+        o_new = o * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p, v_blk)
         return m_new, l_new, o_new
 
     def body(r, carry):
@@ -117,16 +130,16 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         )
         return k_blk, v_blk, m, l, o
 
-    m0 = jnp.full((b, h, s_local), -jnp.inf)
-    l0 = jnp.zeros((b, h, s_local))
-    o0 = jnp.zeros((b, s_local, h, d))
+    m0 = jnp.full((b, hkv, grp, s_local), -jnp.inf)
+    l0 = jnp.zeros((b, hkv, grp, s_local))
+    o0 = jnp.zeros((b, s_local, hkv, grp, d))
     # n-1 iterations rotate + accumulate; the final block needs no send.
     k_blk, v_blk, m, l, o = lax.fori_loop(0, n - 1, body, (k, v, m0, l0, o0))
     m, l, o = block_update((k_blk, v_blk), (my - (n - 1)) % n, m, l, o)
 
     l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked queries (padding) -> 0 output
-    out = o / l_safe.transpose(0, 2, 1)[..., None]
-    return out.astype(dtype)
+    out = o / l_safe.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, s_local, h, d).astype(dtype)
 
 
 _NEG = -1e30  # matches ops/flash_attention._NEG (empty-accumulator sentinel)
@@ -255,6 +268,7 @@ def make_ring_attention(
     causal: bool = False,
     inner: str = "dense",
     interpret: bool | None = None,
+    head_axis: str | None = None,
 ):
     """Build ``attn(q, k, v) -> out`` with the sequence sharded over ``seq_axis``.
 
@@ -262,6 +276,13 @@ def make_ring_attention(
     call it from GSPMD-jitted model code on (B, S, H, D) activations and the
     partitioner feeds it the local shards.  With ``seq_axis`` of size 1 it
     degrades to exactly one block update.
+
+    ``head_axis`` (serving 2-D cp×tp composition, ISSUE 20): when set, the
+    head dimension is additionally sharded over that mesh axis — each chip
+    ring-rotates only its H_kv/tp slice of K/V, so tensor parallelism and
+    context parallelism compose without cross-talk (the ring's ppermute
+    runs along ``seq_axis`` only).  Both H and H_kv must divide the axis
+    size or the call falls back to the unsharded dense/flash path.
 
     ``inner`` picks the per-block computation:
 
@@ -277,7 +298,7 @@ def make_ring_attention(
     """
     if inner not in ("dense", "flash"):
         raise ValueError(f"unknown ring inner {inner!r}; use 'dense' or 'flash'")
-    spec = P(batch_axis, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, head_axis, None)
     if inner == "flash":
         # positional: custom_vjp nondiff_argnums don't mix with kwargs
         def fn(q, k, v):
@@ -287,12 +308,14 @@ def make_ring_attention(
     island = shard_map_compat(fn, mesh, in_specs=(spec, spec, spec), out_specs=spec)
     b_size = mesh.shape[batch_axis] if batch_axis is not None else 1
     s_size = mesh.shape[seq_axis]
+    h_size = mesh.shape[head_axis] if head_axis is not None else 1
 
     def attn(q, k, v):
         # Shapes are static under tracing: when they don't divide the mesh
         # axes (model.init's batch-1 sample, tiny eval remainders), the ring
         # is skipped for the numerically-identical dense path.
-        if q.shape[0] % b_size or q.shape[1] % s_size:
+        if (q.shape[0] % b_size or q.shape[1] % s_size
+                or q.shape[2] % h_size or k.shape[2] % h_size):
             if inner == "flash":
                 from distributed_tensorflow_ibm_mnist_tpu.ops.flash_attention import (
                     flash_attention,

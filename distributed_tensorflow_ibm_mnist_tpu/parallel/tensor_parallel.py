@@ -310,9 +310,11 @@ _KV_HEAD_LEAVES = ("k", "v", "pages_k", "pages_v")
 _KV_SCALE_LEAVES = ("k_scale", "v_scale", "pages_k_scale", "pages_v_scale")
 
 
-def kv_cache_rule(n_shards: int, axis: str = "tp") -> SpecRule:
+def kv_cache_rule(n_shards: int, axis: str = "tp", cp: int = 1,
+                  cp_axis: str = "cp") -> SpecRule:
     """Spec rule for a decode-cache pytree: KV slabs shard over the head
-    axis, everything else (cursors, block tables) replicates.
+    axis (and, with ``cp > 1``, over the SEQUENCE axis too), everything
+    else (cursors, block tables) replicates.
 
     Works on BOTH layouts — dense ``k``/``v`` ``(B, max_len, H_kv, D)``
     slot rows (and the B=1 prefill row caches the insert program
@@ -321,56 +323,107 @@ def kv_cache_rule(n_shards: int, axis: str = "tp") -> SpecRule:
     LAST axis is the head axis.  Divisibility degrades to replicated,
     the same guard :func:`megatron_rule` applies to params (an engine
     that wants the 1/tp memory claim should validate ``tp | heads_kv``
-    up front instead of relying on the degrade)."""
+    up front instead of relying on the degrade).
+
+    ``cp > 1`` (context parallelism, ISSUE 20) adds the sequence-axis
+    sharding: the paged pool shards its PAGE dim 0 over ``cp_axis``
+    (page ``p`` homes on chip row ``p // (n_pages/cp)`` — the
+    (chip, page) addressing is interpretive; the host allocator keeps
+    working in flat page ids), and dense rows shard their ``max_len``
+    dim 1, so each chip row holds ~1/cp of live KV bytes.  Cursors and
+    block tables still replicate — allocation stays layout-invariant."""
     if n_shards < 1:
         raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
 
     def rule(path: tuple[str, ...], leaf) -> P:
         name = path[-1] if path else ""
         shape = getattr(leaf, "shape", ())
-        if (name in _KV_HEAD_LEAVES and len(shape) == 4
-                and shape[2] % n_shards == 0):
-            return P(None, None, axis, None)
-        if (name in _KV_SCALE_LEAVES and len(shape) == 3
-                and shape[2] % n_shards == 0):
-            return P(None, None, axis)
+        if name in _KV_HEAD_LEAVES and len(shape) == 4:
+            head = axis if shape[2] % n_shards == 0 else None
+            if cp > 1 and name.startswith("pages_"):
+                seq = cp_axis if shape[0] % cp == 0 else None
+                spec = P(seq, None, head, None)
+            elif cp > 1:
+                seq = cp_axis if shape[1] % cp == 0 else None
+                spec = P(None, seq, head, None)
+            else:
+                seq, spec = None, P(None, None, head, None)
+            return spec if (head or seq) else P()
+        if name in _KV_SCALE_LEAVES and len(shape) == 3:
+            head = axis if shape[2] % n_shards == 0 else None
+            if cp > 1 and name.startswith("pages_"):
+                seq = cp_axis if shape[0] % cp == 0 else None
+                spec = P(seq, None, head)
+            elif cp > 1:
+                seq = cp_axis if shape[1] % cp == 0 else None
+                spec = P(None, seq, head)
+            else:
+                seq, spec = None, P(None, None, head)
+            return spec if (head or seq) else P()
         return P()
 
     return rule
 
 
-def serving_mesh(tp: int, devices=None) -> Mesh:
-    """A one-axis ``("tp",)`` mesh over ``tp`` devices for the serving
-    decode path.  ``devices`` defaults to the first ``tp`` of
-    ``jax.devices()``; a router composing replicas x disjoint TP groups
+def serving_mesh(tp: int, devices=None, cp: int = 1) -> Mesh:
+    """The serving mesh: one-axis ``("tp",)`` over ``tp`` devices when
+    ``cp == 1`` (unchanged from ISSUE 10), or the 2-D ``("cp", "tp")``
+    mesh over ``cp * tp`` devices when context parallelism is on — row
+    ``i`` of the grid is TP group ``i`` of the ring, so ring hops
+    (``cp`` axis) and attention/MLP psums (``tp`` axis) ride disjoint
+    device pairs.  ``devices`` defaults to the first ``cp * tp`` of
+    ``jax.devices()``; a router composing replicas x disjoint groups
     passes each replica its own slice (:func:`tp_device_groups`)."""
     if tp < 1:
         raise ValueError(f"tp must be >= 1, got {tp}")
-    devs = list(devices) if devices is not None else jax.devices()[:tp]
-    if len(devs) != tp:
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
+    need = cp * tp
+    devs = list(devices) if devices is not None else jax.devices()[:need]
+    if len(devs) != need:
+        what = f"tp={tp}" if cp == 1 else f"tp={tp}, cp={cp}"
         raise ValueError(
-            f"serving_mesh(tp={tp}) needs exactly {tp} devices, got "
+            f"serving_mesh({what}) needs exactly {need} devices, got "
             f"{len(devs)} (of {len(jax.devices())} visible) — on CPU, arm "
             "emulated chips first via utils.hostmesh."
             "ensure_virtual_cpu_devices(n)")
-    arr = np.empty((tp,), dtype=object)
-    arr[:] = devs
-    return Mesh(arr, ("tp",))
+    if cp == 1:
+        arr = np.empty((tp,), dtype=object)
+        arr[:] = devs
+        return Mesh(arr, ("tp",))
+    arr = np.empty((cp, tp), dtype=object)
+    for i, d in enumerate(devs):
+        arr[i // tp, i % tp] = d
+    return Mesh(arr, ("cp", "tp"))
 
 
-def tp_device_groups(n_groups: int, tp: int, devices=None) -> list[list]:
+def tp_device_groups(n_groups: int, tp: int, devices=None,
+                     cp: int = 1) -> list[list]:
     """Partition ``devices`` (default: all visible) into ``n_groups``
-    DISJOINT groups of ``tp`` — the replica-factory seam for a router
-    serving N tensor-parallel replicas: replica ``i`` builds its engine
-    with ``tp_devices=groups[i]`` so failover/hot-swap never shares a
-    chip between failure domains."""
+    DISJOINT groups of ``cp * tp`` — the replica-factory seam for a
+    router serving N parallel replicas: replica ``i`` builds its engine
+    with ``tp_devices=groups[i]`` (or ``cp_devices=`` when ``cp > 1``)
+    so failover/hot-swap never shares a chip between failure domains.
+    Each group is consumed row-major by :func:`serving_mesh`: the first
+    ``tp`` devices are cp-row 0, the next ``tp`` are cp-row 1, ..."""
+    if n_groups < 1:
+        raise ValueError(f"n_groups must be >= 1, got {n_groups}")
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
     devs = list(devices) if devices is not None else jax.devices()
-    need = n_groups * tp
+    per = cp * tp
+    need = n_groups * per
     if len(devs) < need:
+        what = (f"tp_device_groups({n_groups}, {tp})" if cp == 1
+                else f"tp_device_groups({n_groups}, {tp}, cp={cp})")
         raise ValueError(
-            f"tp_device_groups({n_groups}, {tp}) needs {need} devices, "
+            f"{what} needs {need} devices (= groups x cp x tp), "
             f"got {len(devs)}")
-    return [devs[i * tp:(i + 1) * tp] for i in range(n_groups)]
+    return [devs[i * per:(i + 1) * per] for i in range(n_groups)]
 
 
 def mesh_shardings(mesh: Mesh, specs):

@@ -192,6 +192,9 @@ from distributed_tensorflow_ibm_mnist_tpu.core.generate import (
 )
 from distributed_tensorflow_ibm_mnist_tpu.models.quant import quantize_params_int8
 from distributed_tensorflow_ibm_mnist_tpu.models.transformer import reset_cache_slots
+from distributed_tensorflow_ibm_mnist_tpu.parallel.ring_attention import (
+    make_ring_attention,
+)
 from distributed_tensorflow_ibm_mnist_tpu.parallel.tensor_parallel import (
     kv_cache_rule,
     make_param_specs,
@@ -343,6 +346,7 @@ class InferenceEngine:
                  radix_cache: bool | None = None,
                  prefill_chunk: int = 0,
                  tp: int = 1, tp_devices=None,
+                 cp: int = 1, cp_devices=None,
                  quant: str | None = None,
                  eos_id: int | None = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
@@ -463,6 +467,28 @@ class InferenceEngine:
                     "KV head-axis shard both partition WHOLE heads — a "
                     "silent replicated degrade would void the 1/tp "
                     "per-chip memory claim")
+        # --- context parallelism (ISSUE 20): sequence-sharded paged KV
+        # over the cp axis of a 2-D cp×tp mesh, ring-attention prefill ---
+        if cp < 1:
+            raise ValueError(f"cp must be >= 1, got {cp}")
+        if cp > 1:
+            if not kv_page_size:
+                raise ValueError(
+                    "cp > 1 shards the PAGED KV pool along its page axis — "
+                    "context-parallel serving needs the paged cache "
+                    "(kv_page_size > 0); the dense per-slot layout has no "
+                    "sequence axis a chip row could own")
+            if max_len % cp:
+                raise ValueError(
+                    f"max_len ({max_len}) must be a multiple of cp ({cp}) "
+                    "so every slot's virtual span splits into equal "
+                    "per-chip-row sequence shards")
+            if getattr(model, "attn_fn", None) is not None:
+                raise ValueError(
+                    "cp > 1 installs ring attention as the model's "
+                    "attn_fn for prefill — a model that already carries a "
+                    "custom attn_fn would be silently clobbered; pass the "
+                    "base model and let the engine compose the ring")
         # persistent XLA compilation cache (opt-in): warm processes skip
         # recompiling the engine's program family — the r04→r05 cold-start
         # regression lever.  Semantics per core/trainer.resolve_compile_
@@ -500,18 +526,25 @@ class InferenceEngine:
                     f"(the causal-LM family); {type(model).__name__} has "
                     "none") from None
             params = quantize_params_int8(params)
-        # --- tensor-parallel mesh (tp=1: every attribute None, the whole
-        # path byte-identical to the single-chip engine) --- the serving
-        # half of ROADMAP item 5b: weights column/row-sharded by the SAME
-        # Megatron rule the training mesh uses, KV cache sharded over the
-        # head axis, one psum per attention block and one per MLP inserted
-        # by the partitioner at the column->row boundaries.  Everything
-        # host-side (scheduler, pool, radix trie, drafter) never sees the
-        # mesh — allocation decisions are identical at any tp.
+        # --- tensor/context-parallel mesh (tp=cp=1: every attribute None,
+        # the whole path byte-identical to the single-chip engine) --- the
+        # serving half of ROADMAP item 5b: weights column/row-sharded by
+        # the SAME Megatron rule the training mesh uses, KV cache sharded
+        # over the head axis, one psum per attention block and one per MLP
+        # inserted by the partitioner at the column->row boundaries.  With
+        # cp > 1 (ROADMAP item 2, ISSUE 20) the mesh grows a leading
+        # ``cp`` axis: params REPLICATE over it (megatron_rule names only
+        # "tp"), the paged pool shards its page axis over it
+        # (kv_cache_rule cp=), and prefill runs ring attention along it.
+        # Everything host-side (scheduler, pool, radix trie, drafter)
+        # never sees the mesh — allocation decisions are identical at any
+        # (cp, tp).
         self.tp = int(tp)
-        if tp > 1:
-            self._mesh = serving_mesh(tp, tp_devices)
-            self._kv_rule = kv_cache_rule(tp, axis="tp")
+        self.cp = int(cp)
+        if tp > 1 or cp > 1:
+            mesh_devices = cp_devices if cp_devices is not None else tp_devices
+            self._mesh = serving_mesh(tp, mesh_devices, cp=cp)
+            self._kv_rule = kv_cache_rule(tp, axis="tp", cp=cp)
             self._param_shardings = mesh_shardings(
                 self._mesh,
                 make_param_specs(params, megatron_rule(tp, axis="tp")))
@@ -639,8 +672,19 @@ class InferenceEngine:
             n_row = max_len // kv_page_size
             if not kv_pages:
                 # default: dense-equivalent capacity (+ the trash page) —
-                # overcommit is opt-in via an explicit smaller kv_pages
+                # overcommit is opt-in via an explicit smaller kv_pages.
+                # Under cp the pool's page axis shards cp ways, so the
+                # default rounds UP to the next multiple of cp (a few
+                # bonus pages, never fewer than dense-equivalent).
                 kv_pages = slots * n_row + 1
+                if self.cp > 1 and kv_pages % self.cp:
+                    kv_pages += self.cp - kv_pages % self.cp
+            elif self.cp > 1 and kv_pages % self.cp:
+                raise ValueError(
+                    f"kv_pages ({kv_pages}) must be a multiple of cp "
+                    f"({self.cp}): the pool's page axis shards evenly "
+                    "across the cp rows, or the 1/cp per-chip memory "
+                    "claim silently degrades to replicated")
             if kv_pages < n_row + 1:
                 raise ValueError(
                     f"kv_pages ({kv_pages}) cannot hold one full-length "
@@ -668,7 +712,32 @@ class InferenceEngine:
                 return tree
         self._pin_kv = _pin
 
-        self._prefill = make_prefill(model, max_len)     # per-bucket shapes
+        # cp > 1 promotes ring attention from the training path into the
+        # prefill program family (ISSUE 20): the prefill model's forward
+        # runs attention as a shard_map island over the mesh's cp axis
+        # (sequence-sharded K/V rotating via ppermute, GQA kept grouped at
+        # H_kv width) with heads still sharded over tp.  Decode-mode
+        # programs never consult attn_fn (the paged gather-based decode
+        # attention reads the SEQUENCE-sharded pool and the partitioner
+        # derives the cross-row collectives), so only the prefill family
+        # changes.  Buckets that don't divide cp fall back to the
+        # numerically-equivalent unsharded path inside the returned
+        # callable — still one program per (site, shape-key).
+        if self.cp > 1:
+            ring = make_ring_attention(
+                self._mesh, batch_axis=None, seq_axis="cp",
+                head_axis="tp" if tp > 1 else None,
+                causal=bool(getattr(model, "causal", True)))
+            try:
+                prefill_model = model.clone(attn_fn=ring)
+            except TypeError:
+                raise ValueError(
+                    f"cp={cp} needs a model with an attn_fn= field (the "
+                    f"causal-LM family); {type(model).__name__} has none"
+                ) from None
+        else:
+            prefill_model = model
+        self._prefill = make_prefill(prefill_model, max_len)  # per-bucket shapes
         if kv_page_size:
             _insert_fn = make_paged_insert(kv_page_size, max_len)
             _reset_fn = paged_reset
@@ -902,7 +971,21 @@ class InferenceEngine:
         self.stats.memory(
             tp=self.tp, kv_bytes_per_chip=self.kv_bytes_per_chip(),
             weight_bytes_per_chip=self.weight_bytes_per_chip(),
-            quant=self.quant)
+            quant=self.quant, cp=self.cp)
+
+    def _site(self, name: str) -> str:
+        """Path-qualified compile-site name (ISSUE 20 satellite): cp=1
+        engines keep every historical site name byte-identical; cp>1
+        qualifies each site with the layout — ``prefill[b16]`` becomes
+        ``prefill[b16,cp2]``, ``first_pick`` becomes ``first_pick[cp2]``
+        — so a census diff between layouts attributes every compile to
+        its (site, shape-key, LAYOUT) and prewarm/serving keys always
+        agree (both come through this helper)."""
+        if self.cp == 1:
+            return name
+        if name.endswith("]"):
+            return f"{name[:-1]},cp{self.cp}]"
+        return f"{name}[cp{self.cp}]"
 
     def _dev(self, x):
         """Host upload for per-window device inputs.  Single-chip: a plain
@@ -920,9 +1003,11 @@ class InferenceEngine:
         return None if self._mesh is None else self._mesh.devices.flat[0]
 
     def kv_bytes_per_chip(self) -> int:
-        """KV-cache bytes resident on ONE chip — the whole cache at tp=1;
-        the head-axis shard plus the replicated block tables/cursors under
-        tp (1/tp of the slab bytes, the ISSUE 10 memory claim)."""
+        """KV-cache bytes resident on ONE chip — the whole cache at
+        tp=cp=1; the head-axis shard under tp (1/tp of the slab bytes,
+        the ISSUE 10 memory claim) and additionally the page-axis shard
+        under cp (1/(tp*cp) of the slab — the ISSUE 20 claim), plus the
+        replicated block tables/cursors (the documented tax)."""
         return per_chip_bytes(self.cache, self._chip0)
 
     def weight_bytes_per_chip(self) -> int:
@@ -1048,7 +1133,7 @@ class InferenceEngine:
         radix-extend landing, so hit/miss first tokens are bit-identical.
         Returns ``(token, logprob)`` as host scalars."""
         temp, topp, topk, minp, key = self._req_sampling(req)
-        with self._compile.site("first_pick"):
+        with self._compile.site(self._site("first_pick")):
             tok, logp = first_pick(
                 logits, self._dev(np.array([temp], np.float32)),
                 self._dev(np.array([topp], np.float32)),
@@ -1196,15 +1281,49 @@ class InferenceEngine:
                                    parent=req.trace["phase"] or req.trace["id"],
                                    tid=req.trace["tid"], bucket=req.bucket)
                 if self._tracer is not None and req.trace is not None else None)
+        t0 = self.clock()
         try:
-            with self._compile.site(f"prefill[b{req.bucket}]"):
+            with self._compile.site(self._site(f"prefill[b{req.bucket}]")):
                 row_cache, logits = self._prefill_row(
                     self.params, jnp.asarray(padded),
                     jnp.asarray([req.tokens.size], jnp.int32))
         finally:
             if span is not None:
                 self._tracer.end(span)  # a poisoned prefill still closes it
+                if self.cp > 1 and req.bucket % self.cp == 0:
+                    self._emit_ring_hops(req, span, t0, self.clock())
         return row_cache, logits
+
+    def _emit_ring_hops(self, req: Request, parent_span, t0: float,
+                        t1: float) -> None:
+        """Per-hop ``ring_hop`` child spans under a cp>1 prefill span
+        (ISSUE 20 satellite).  The XLA dispatch is one fused program — the
+        cp-1 ppermute hops have no host-visible boundaries — so each hop
+        is rendered as a uniform slice of the measured dispatch window,
+        annotated with the ANALYTIC per-hop comm bytes (utils/flops.
+        ring_hop_bytes at the grouped H_kv width): honest structure +
+        honest byte accounting, no fake per-hop timings claimed beyond
+        the uniform-slice convention the span args spell out."""
+        if self._tracer is None or req.trace is None:
+            return
+        from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+            ring_hop_bytes,
+        )
+
+        m = self.model
+        heads_kv = getattr(m, "heads_kv", None) or getattr(m, "heads", 1)
+        head_dim = getattr(m, "dim", 0) // max(getattr(m, "heads", 1), 1)
+        hop_bytes = ring_hop_bytes(
+            req.bucket // self.cp, heads_kv, head_dim,
+            dtype_bytes=jnp.dtype(getattr(m, "dtype", jnp.float32)).itemsize,
+            depth=getattr(m, "depth", 1))
+        n_hops = self.cp - 1
+        dt = max(t1 - t0, 0.0) / max(n_hops, 1)
+        for h in range(n_hops):
+            self._tracer.complete(
+                "ring_hop", t0 + h * dt, t0 + (h + 1) * dt, cat="serving",
+                parent=parent_span, tid=req.trace["tid"], hop=h,
+                comm_bytes=hop_bytes, timing="uniform-slice")
 
     def _usable_radix_tokens(self, req: Request, matched: int | None = None
                              ) -> int:
@@ -1299,7 +1418,7 @@ class InferenceEngine:
             sb = self.scheduler.bucket_for(suffix.size)
             padded = np.full((1, sb), self.pad_id, np.int32)
             padded[0, : suffix.size] = suffix
-            with self._compile.site(f"extend[b{sb}]"):
+            with self._compile.site(self._site(f"extend[b{sb}]")):
                 self.cache, ext_logits = self._extend(
                     self.params, self.cache, jnp.asarray(slot, jnp.int32),
                     bt_dev, jnp.asarray(padded),
@@ -1317,7 +1436,7 @@ class InferenceEngine:
             req.radix_tokens = m_tok
             self._tr_instant(req, "radix_hit", blocks=m_blocks, tokens=m_tok)
         else:
-            with self._compile.site("slot_insert"):
+            with self._compile.site(self._site("slot_insert")):
                 self.cache = self._insert(self.cache, row_cache, bt_dev,
                                           jnp.asarray(slot, jnp.int32))
             if self.role == "prefill":
@@ -1400,7 +1519,7 @@ class InferenceEngine:
                     return True
             else:
                 row_cache, logits, cache_hit = prefilled
-                with self._compile.site("slot_insert"):
+                with self._compile.site(self._site("slot_insert")):
                     self.cache = self._insert(
                         self.cache, row_cache, jnp.asarray(slot, jnp.int32))
                 inserted = True
@@ -1583,7 +1702,7 @@ class InferenceEngine:
             # ONE program per chunk SIZE, not per prompt length: every
             # chunk of every prompt is this same (1, C) extend — the
             # census stays pinned and long prompts need no bucket
-            with self._compile.site(f"extend[b{c}]"):
+            with self._compile.site(self._site(f"extend[b{c}]")):
                 self.cache, ext_logits = self._extend(
                     self.params, self.cache, jnp.asarray(slot, jnp.int32),
                     rec["bt_dev"], jnp.asarray(padded),
@@ -1839,7 +1958,7 @@ class InferenceEngine:
         on the single device stream, same as step()'s batched reset."""
         mask = np.zeros((self.slots,), bool)
         mask[slot] = True
-        with self._compile.site("slot_reset"):
+        with self._compile.site(self._site("slot_reset")):
             self.cache = self._reset(self.cache, self._dev(mask))
         self._flush_freed_pages()
 
@@ -1926,7 +2045,7 @@ class InferenceEngine:
                         if d.size:
                             chunk[slot, 1:1 + d.size] = d
                             dls[slot] = d.size
-                    with self._compile.site("slot_draft"):
+                    with self._compile.site(self._site("slot_draft")):
                         chunk_dev = self._dev(chunk)
                         dls_dev = self._dev(dls)
                         # acceptance makes the PRNG position advance
@@ -1964,14 +2083,14 @@ class InferenceEngine:
                  keys_dev) = self._planes_dev
                 t_disp = self.clock()
                 if spec:
-                    with self._compile.site(f"verify_window[k{k}]"):
+                    with self._compile.site(self._site(f"verify_window[k{k}]")):
                         self.cache, blk_dev, logp_dev, acc_dev, _ = \
                             self._verify(
                                 self.params, self.cache, chunk_dev, dls_dev,
                                 self._active_dev, temps_dev, topps_dev,
                                 topks_dev, minps_dev, keys_dev, pos_dev)
                 else:
-                    with self._compile.site(f"decode_window[k{k}]"):
+                    with self._compile.site(self._site(f"decode_window[k{k}]")):
                         self.cache, blk_dev, logp_dev, last_dev, pos_out = \
                             self._window(
                                 self.params, self.cache, self._tok_dev,
@@ -2125,7 +2244,7 @@ class InferenceEngine:
         # 4) zero retired rows so idle cursors restart from 0 (bounded) and
         #    the next admission starts from a clean row
         if reset_mask.any():
-            with self._compile.site("slot_reset"):
+            with self._compile.site(self._site("slot_reset")):
                 self.cache = self._reset(self.cache, self._dev(reset_mask))
         # deferred page frees apply only now, AFTER the reset dispatch is
         # enqueued: single-stream device execution guarantees every program
@@ -2398,7 +2517,7 @@ class InferenceEngine:
             # pins that no prefill[b*]/extend[b*] site ever appears
             vocab = getattr(self.model, "num_classes")
             last_logits = self._dev(np.zeros((1, vocab), np.float32))
-            with self._compile.site("handoff_install"):
+            with self._compile.site(self._site("handoff_install")):
                 # zero payload through the SAME _dev commitment the real
                 # admit_prefilled upload uses, so tp engines compile one
                 # page-writer here and reuse it for every handoff
@@ -2420,7 +2539,7 @@ class InferenceEngine:
             c = self._prefill_chunk
             bt_row = self._dev(np.zeros((self.max_len // self._page_size,),
                                         np.int32))
-            with self._compile.site(f"extend[b{c}]"):
+            with self._compile.site(self._site(f"extend[b{c}]")):
                 self.cache, last_logits = self._extend(
                     self.params, self.cache, slot0, bt_row,
                     jnp.zeros((1, c), jnp.int32),
@@ -2429,16 +2548,19 @@ class InferenceEngine:
         else:
             last_logits = None
             for b in self.buckets:
-                with self._compile.site(f"prefill[b{b}]"):
+                with self._compile.site(self._site(f"prefill[b{b}]")):
+                    # lens through the same list->asarray route
+                    # _dense_prefill uses, so its scalar-conversion
+                    # program is warm too, not just the prefill itself
                     _, last_logits = self._prefill_row(
                         self.params, jnp.zeros((1, b), jnp.int32),
-                        jnp.ones((1,), jnp.int32))
+                        jnp.asarray([1], jnp.int32))
         if self.role == "prefill":
             # the source half of the handoff family: the ONE fixed-shape
             # page gather every transferred page reads through (read-only
             # — jitted without donation), warmed so the first packet pays
             # zero compile
-            with self._compile.site("handoff_gather"):
+            with self._compile.site(self._site("handoff_gather")):
                 jax.block_until_ready(self._page_gather(
                     self.cache, jnp.asarray(0, jnp.int32)))
         # the shared first-token pick over the (1, V) prefill logits —
@@ -2447,14 +2569,20 @@ class InferenceEngine:
         # runs on the decode side from the handed-off logits row), so it
         # skips this — its census carries zero pick/decode programs.
         if self.role != "prefill":
-            with self._compile.site("first_pick"):
-                first_pick(last_logits,
-                           self._dev(np.zeros((1,), np.float32)),
-                           self._dev(np.zeros((1,), np.float32)),
-                           self._dev(np.zeros((1,), np.int32)),
-                           self._dev(np.zeros((1,), np.float32)),
-                           self._dev(np.zeros((1, 2), np.uint32)),
-                           self._dev(np.zeros((1,), np.int32)))
+            with self._compile.site(self._site("first_pick")):
+                tok, logp = first_pick(
+                    last_logits,
+                    self._dev(np.zeros((1,), np.float32)),
+                    self._dev(np.zeros((1,), np.float32)),
+                    self._dev(np.zeros((1,), np.int32)),
+                    self._dev(np.zeros((1,), np.float32)),
+                    self._dev(np.zeros((1, 2), np.uint32)),
+                    self._dev(np.zeros((1,), np.int32)))
+                # the landing path reads the pick eagerly (_first_pick
+                # returns python scalars); under a mesh those committed
+                # outputs key their own tiny gather programs, so read
+                # them here or the first real admission compiles them
+                int(tok[0]), float(logp[0])
         if not self._prefill_chunk and self.role != "decode":
             # a zeroed B=1 prefill row in the dense decode layout — the
             # same eval_shape probe init_cache uses, so dtypes (incl.
@@ -2476,18 +2604,18 @@ class InferenceEngine:
             if self._pool is not None:
                 bt_row = self._dev(
                     np.zeros((self.max_len // self._page_size,), np.int32))
-                with self._compile.site("slot_insert"):
+                with self._compile.site(self._site("slot_insert")):
                     self.cache = self._insert(self.cache, row_cache, bt_row,
                                               slot0)
                 for b in self.buckets:
-                    with self._compile.site(f"extend[b{b}]"):
+                    with self._compile.site(self._site(f"extend[b{b}]")):
                         self.cache, _ = self._extend(
                             self.params, self.cache, slot0, bt_row,
                             jnp.zeros((1, b), jnp.int32),
                             jnp.asarray(0, jnp.int32),
                             jnp.asarray(1, jnp.int32))
             else:
-                with self._compile.site("slot_insert"):
+                with self._compile.site(self._site("slot_insert")):
                     self.cache = self._insert(self.cache, row_cache, slot0)
         inactive = self._dev(np.zeros((self.slots,), bool))
         if self.role != "prefill":
@@ -2501,7 +2629,7 @@ class InferenceEngine:
             pos0 = self._dev(np.zeros((self.slots,), np.int32))
             if self._verify is not None:
                 k = self.draft_len + 1
-                with self._compile.site(f"verify_window[k{k}]"):
+                with self._compile.site(self._site(f"verify_window[k{k}]")):
                     self.cache, _, _, _, _ = self._verify(
                         self.params, self.cache,
                         self._dev(np.full((self.slots, k), self.pad_id,
@@ -2511,13 +2639,13 @@ class InferenceEngine:
                         pos0)
             else:
                 k = self.decode_ahead
-                with self._compile.site(f"decode_window[k{k}]"):
+                with self._compile.site(self._site(f"decode_window[k{k}]")):
                     self.cache, _, _, _, _ = self._window(
                         self.params, self.cache,
                         self._dev(np.zeros((self.slots,), np.int32)),
                         inactive, temps0, topps0, topks0, minps0, keys0,
                         pos0)
-        with self._compile.site("slot_reset"):
+        with self._compile.site(self._site("slot_reset")):
             self.cache = self._reset(self.cache, inactive)
         delta = CompileTracker.delta(self._compile.snapshot(), before)
         return {"programs": delta["n_compiled_programs"],

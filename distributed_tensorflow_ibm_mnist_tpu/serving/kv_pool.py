@@ -32,6 +32,19 @@ Everything jitted here is donation-friendly: the engine wraps
 ``make_paged_insert``/``paged_reset``/``make_paged_extend`` in ``jax.jit``
 with the cache donated, same as the dense path (the ~23% donation win from
 PR 2 carries over — the pool is the dominant buffer either way).
+
+Context parallelism (ISSUE 20) never touches this module's code: under a
+``cp > 1`` serving mesh the engine shards every ``pages_*`` leaf along its
+PAGE axis (``kv_cache_rule`` pins ``P("cp", None, head, None)``), so each
+of the ``cp`` chip rows physically holds ``n_pages / cp`` page slabs —
+1/cp of the live KV bytes — while the block table and ``KVPagePool``
+keep addressing the same GLOBAL page ids.  The (chip, page) split is the
+partitioner's business: inserts scatter to whichever chip row owns the
+target slab, decode's per-row gather assembles the attended span across
+rows, and the host-side allocator, radix refcounts, and trash-page
+protocol are layout-invariant — the same integers mean the same pages at
+any cp.  The only cp-visible constraint lives in the engine: ``n_pages``
+must divide by ``cp`` so the page axis shards evenly.
 """
 
 from __future__ import annotations

@@ -227,6 +227,7 @@ class ServingStats:
         # the engine (memory()); tp=1 with whole-tree bytes on single-chip
         # engines, so the schema never branches on the mesh
         self._tp = 1
+        self._cp = 1  # context-parallel degree (ISSUE 20); 1 off-mesh
         self._kv_bytes_per_chip: int | None = None
         self._weight_bytes_per_chip: int | None = None
         self._quant = "none"  # weight storage scheme ("int8" when the
@@ -313,13 +314,16 @@ class ServingStats:
         self._longest_prompt = max(self._longest_prompt, int(n_tokens))
 
     def memory(self, tp: int, kv_bytes_per_chip: int,
-               weight_bytes_per_chip: int, quant: str = "none") -> None:
-        """Stamp the engine's tensor-parallel degree, per-chip memory
+               weight_bytes_per_chip: int, quant: str = "none",
+               cp: int = 1) -> None:
+        """Stamp the engine's parallel degrees (``tp``, and ``cp`` for
+        context-parallel serving — 1 everywhere else), per-chip memory
         footprint (parallel/tensor_parallel.per_chip_bytes over the cache
         and the decode weights), and weight storage scheme (``quant``).
         Re-stamped at every emit point, so a stats object swapped in
         mid-run still reports them."""
         self._tp = int(tp)
+        self._cp = int(cp)
         self._kv_bytes_per_chip = int(kv_bytes_per_chip)
         self._weight_bytes_per_chip = int(weight_bytes_per_chip)
         self._quant = str(quant)
@@ -489,9 +493,10 @@ class ServingStats:
             "kv_pages_peak": self._kv_pages_peak,
             "kv_bytes_live": self._kv_pages_live * self._kv_page_bytes,
             "kv_bytes_peak": self._kv_pages_peak * self._kv_page_bytes,
-            # tensor-parallel per-chip footprint (tp=1 / None until the
-            # engine stamps it — null, never NaN)
+            # tensor/context-parallel per-chip footprint (tp=cp=1 / None
+            # until the engine stamps it — null, never NaN)
             "tp": self._tp,
+            "cp": self._cp,
             "kv_bytes_per_chip": self._kv_bytes_per_chip,
             "weight_bytes_per_chip": self._weight_bytes_per_chip,
             "quant": self._quant,
@@ -639,11 +644,13 @@ class ServingStats:
         n_sampled = sum(rec._n_sampled for rec in records)
         temp_sum = sum(rec._temp_sum for rec in records)
         nll = HistogramSketch.merge([rec._nll for rec in records])
-        # replicas hold DISJOINT TP groups (parallel/tensor_parallel.
+        # replicas hold DISJOINT chip groups (parallel/tensor_parallel.
         # tp_device_groups), so the cluster's per-chip figure is the worst
-        # chip anywhere (max), the cluster total sums per_chip * tp per
-        # engine, and `tp` reports the common degree or None when mixed
+        # chip anywhere (max), the cluster total sums per_chip * tp * cp
+        # per engine, and `tp`/`cp` report the common degree or None when
+        # mixed (a heterogeneous-cp fleet is visible, never averaged)
         tps = {rec._tp for rec in records}
+        cps = {rec._cp for rec in records}
         quants = {rec._quant for rec in records}
         stamped = [rec for rec in records
                    if rec._kv_bytes_per_chip is not None]
@@ -731,6 +738,7 @@ class ServingStats:
             "longest_prompt_admitted": (
                 max(longest) if longest else None),
             "tp": tps.pop() if len(tps) == 1 else None,
+            "cp": cps.pop() if len(cps) == 1 else None,
             # common scheme or None when replicas disagree (a mid-rollout
             # mixed fleet is visible, never silently averaged)
             "quant": quants.pop() if len(quants) == 1 else None,
@@ -741,10 +749,12 @@ class ServingStats:
                 max(rec._weight_bytes_per_chip for rec in stamped)
                 if stamped else None),
             "kv_bytes_cluster": (
-                sum(rec._kv_bytes_per_chip * rec._tp for rec in stamped)
+                sum(rec._kv_bytes_per_chip * rec._tp * rec._cp
+                    for rec in stamped)
                 if stamped else None),
             "weight_bytes_cluster": (
-                sum(rec._weight_bytes_per_chip * rec._tp for rec in stamped)
+                sum(rec._weight_bytes_per_chip * rec._tp * rec._cp
+                    for rec in stamped)
                 if stamped else None),
             "n_compiled_programs": (
                 sum(c["n_compiled_programs"] for c in compiled)

@@ -78,7 +78,7 @@ def device_peak_tflops(device=None) -> float | None:
 def attention_flops(
     batch: int, seq: int, heads: int, head_dim: int, *,
     causal: bool = False, with_backward: bool = True, depth: int = 1,
-    window: int = 0,
+    window: int = 0, cp: int = 1,
 ) -> float:
     """Analytic matmul FLOPs of multi-head attention, standard model-FLOPs
     convention: forward is the QK^T and PV matmuls (4*B*S^2*H*D), backward
@@ -96,7 +96,18 @@ def attention_flops(
     on this count is conservative w.r.t. what the MXU actually ran,
     matching how the dense path's XLA cost analysis treats it (validated
     against each other in tests/test_flops.py).
+
+    ``cp > 1`` (ring attention over a context-parallel mesh, ISSUE 20)
+    reports the PER-CHIP average: the semantic FLOPs of the whole
+    attention are unchanged, but each of the ``cp`` chips scores only its
+    S/cp queries against the rotating K/V blocks, so the per-chip MFU
+    numerator is the total divided by ``cp`` (causal rings are load-
+    imbalanced step by step, but the n-step total is uniform — the
+    average is the honest steady-state figure).  Comm bytes are NOT
+    FLOPs; charge those separately via :func:`ring_hop_bytes`.
     """
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
     if causal and window:
         w = min(window, seq)
         pairs = seq * w - w * w / 2.0  # sum of min(q+1, W), half-diagonal conv.
@@ -107,12 +118,13 @@ def attention_flops(
             f /= 2.0
     if with_backward:
         f *= 3.0
-    return f
+    return f / cp
 
 
 def decode_step_flops(
     batch: int, kv_span: int, dim: int, heads: int, head_dim: int, *,
     heads_kv: int | None = None, depth: int = 1, vocab: int = 0,
+    cp: int = 1,
 ) -> float:
     """Analytic matmul FLOPs of ONE incremental decode step (S=1 per row),
     GQA-aware — the MFU numerator for serving decode benches.
@@ -133,18 +145,51 @@ def decode_step_flops(
     is MHA and reproduces the ungrouped count exactly.  Forward only —
     decode has no backward.  ``vocab > 0`` adds the final logits matmul
     ``2*B*dim*vocab`` (once, not per layer).
+
+    ``cp > 1`` (context-parallel serving, ISSUE 20) is the PER-CHIP
+    count: the sequence-sharded KV pool leaves each chip row attending
+    over only ``ceil(kv_span / cp)`` cached positions, so the attention
+    term shrinks to the per-chip width while the projections and MLP —
+    replicated over the ``cp`` axis — stay whole.  The exact cp=1 delta
+    is ``depth * 4*B*Hkv*D * (ceil(kv_span/cp) - kv_span)`` (pinned in
+    tests/test_flops.py); the m/l/o merge psum it buys is comm, not
+    FLOPs — see :func:`ring_hop_bytes`.
     """
     hkv = heads if heads_kv is None else heads_kv
     if not 0 < hkv <= heads:
         raise ValueError(f"heads_kv must be in 1..heads, got {hkv}/{heads}")
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
+    span_chip = -(-kv_span // cp)  # ceil: each chip row's attended width
     per_layer = (
         2.0 * batch * dim * heads * head_dim          # q projection
         + 2.0 * batch * dim * 2 * hkv * head_dim      # k+v projection
-        + 4.0 * batch * kv_span * hkv * head_dim      # QK^T + PV (grouped)
+        + 4.0 * batch * span_chip * hkv * head_dim    # QK^T + PV (grouped)
         + 2.0 * batch * heads * head_dim * dim        # out projection
         + 16.0 * batch * dim * dim                    # MLP (4x, two mats)
     )
     return per_layer * depth + 2.0 * batch * dim * vocab
+
+
+def ring_hop_bytes(
+    seq_local: int, heads_kv: int, head_dim: int, *,
+    batch: int = 1, dtype_bytes: int = 4, depth: int = 1,
+) -> int:
+    """Bytes ONE chip sends per ring hop of context-parallel prefill:
+    the rotating K + V blocks at their GROUPED ``H_kv`` width (the
+    grouped ring path never expands GQA before the hop — satellite 1 of
+    ISSUE 20), ``2 * B * S_local * H_kv * D * dtype_bytes`` per layer.
+    A full prefill performs ``cp - 1`` hops per layer, so total ring
+    traffic per chip is ``(cp - 1) * ring_hop_bytes(...)`` — the figure
+    the ``ring_hop`` trace spans and bench_cp_serving.py report.  On
+    this CPU-emulation box the ppermute is a memcpy; the byte count is
+    the honest analytic charge for real-ICI projections."""
+    if seq_local < 0 or heads_kv < 1 or head_dim < 1:
+        raise ValueError(
+            f"bad ring hop shape: seq_local={seq_local}, "
+            f"heads_kv={heads_kv}, head_dim={head_dim}")
+    return int(2 * batch * seq_local * heads_kv * head_dim
+               * dtype_bytes * depth)
 
 
 def compiled_flops(jitted_fn, *args) -> float | None:

@@ -122,9 +122,11 @@ def time_config(trainer, batch: int, prompt_len: int, max_new: int,
     # episode runs), consistent with roofline_bytes' span convention
     from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
         decode_step_flops, mfu)
+    # cp=1 spelled out: this bench decodes on a single chip; the cp>1
+    # per-chip variant (sequence-sharded KV) is bench_cp_serving's job
     step_flops = decode_step_flops(
         batch, kv_span or max_len, DIM, HEADS, DIM // HEADS,
-        heads_kv=hkv, depth=DEPTH, vocab=VOCAB)
+        heads_kv=hkv, depth=DEPTH, vocab=VOCAB, cp=1)
     step_mfu = mfu(step_flops / (net / max_new))
     row = {
         "config": label, "batch": batch, "prompt_len": prompt_len,

@@ -37,6 +37,7 @@ FLAG_KEYS = {
     "DTM_BENCH_SKIP_SHARDED": ["dp_sharded_update"],
     "DTM_BENCH_SKIP_SERVING": ["serving", "kv_paging"],
     "DTM_BENCH_SKIP_TP": ["tp_serving"],
+    "DTM_BENCH_SKIP_CP": ["cp_serving"],
     "DTM_BENCH_SKIP_CHAOS": ["chaos"],
     "DTM_BENCH_SKIP_ROUTER": ["router"],
     "DTM_BENCH_SKIP_SPEC": ["speculative"],

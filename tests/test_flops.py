@@ -74,6 +74,68 @@ def test_decode_step_flops_gqa_grouped():
         decode_step_flops(b, span, dim, h, d, heads_kv=h + 1)
 
 
+def test_decode_step_flops_cp_exact_delta():
+    """ISSUE 20 satellite pin: cp shrinks ONLY the cache-attention term,
+    to the per-chip ceil(span/cp) width — the exact cp=1 delta is
+    ``depth * 4*B*Hkv*D * (ceil(span/cp) - span)``, projections and MLP
+    untouched (they replicate over the cp axis)."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+        decode_step_flops,
+    )
+
+    b, dim, h, d, depth = 8, 512, 8, 64, 3
+    hkv = h // 4
+    for span in (4096, 4097):  # even split and the ceil remainder
+        for cp in (1, 2, 4):
+            full = decode_step_flops(b, span, dim, h, d, heads_kv=hkv,
+                                     depth=depth)
+            chip = decode_step_flops(b, span, dim, h, d, heads_kv=hkv,
+                                     depth=depth, cp=cp)
+            want = depth * 4.0 * b * hkv * d * (-(-span // cp) - span)
+            assert chip - full == want, (span, cp)
+    assert decode_step_flops(b, 4096, dim, h, d, cp=1) == decode_step_flops(
+        b, 4096, dim, h, d)
+    with pytest.raises(ValueError):
+        decode_step_flops(b, 4096, dim, h, d, cp=0)
+
+
+def test_attention_flops_cp_per_chip_average():
+    """Prefill's cp figure is the plain per-chip average total/cp (the
+    causal ring's step imbalance sums away), composing with every other
+    knob; cp=1 is the identity and cp<1 refuses."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+        attention_flops,
+    )
+
+    base = attention_flops(2, 128, 8, 64, causal=True, depth=3)
+    for cp in (2, 4):
+        assert attention_flops(2, 128, 8, 64, causal=True, depth=3,
+                               cp=cp) == base / cp
+    assert attention_flops(2, 128, 8, 64, cp=1) == attention_flops(
+        2, 128, 8, 64)
+    with pytest.raises(ValueError):
+        attention_flops(2, 128, 8, 64, cp=0)
+
+
+def test_ring_hop_bytes():
+    """One hop = the rotating K+V blocks at the GROUPED width: exactly
+    ``2 * B * S_local * H_kv * D * dtype_bytes * depth`` — an H (not
+    H_kv) regression would overcharge GQA rings by the group factor."""
+    from distributed_tensorflow_ibm_mnist_tpu.utils.flops import (
+        ring_hop_bytes,
+    )
+
+    assert ring_hop_bytes(24, 2, 16) == 2 * 1 * 24 * 2 * 16 * 4 * 1
+    assert ring_hop_bytes(24, 2, 16, batch=3, dtype_bytes=2,
+                          depth=4) == 2 * 3 * 24 * 2 * 16 * 2 * 4
+    assert ring_hop_bytes(0, 2, 16) == 0  # degenerate local slice
+    for bad in (dict(seq_local=-1, heads_kv=2, head_dim=16),
+                dict(seq_local=8, heads_kv=0, head_dim=16),
+                dict(seq_local=8, heads_kv=2, head_dim=0)):
+        with pytest.raises(ValueError):
+            ring_hop_bytes(**bad)
+
+
 def test_measure_throughput_public_api(monkeypatch):
     """Supported benchmark path: sane numbers, MFU populated when a peak is
     known, and the trainer's state restored untouched."""

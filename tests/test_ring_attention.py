@@ -55,6 +55,34 @@ def test_ring_matches_vanilla_grads(eight_devices, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa_grouped_matches_vanilla(eight_devices, causal):
+    """GQA through the grouped ring path (K/V rotate at H_kv width — never
+    group-expanded) on a prime per-shard length: sp=2 over S=14 gives
+    S_local=7, so every block boundary is misaligned with the group
+    structure and any indexing slip shows up."""
+    rng = np.random.default_rng(7)
+    b, s, h, hkv, d = 2, 14, 4, 2, 16
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, s, hkv, d)).astype(np.float32))
+    mesh = make_mesh(dp=1, sp=2)
+    ring = jax.jit(make_ring_attention(mesh, batch_axis=None, causal=causal))
+    got = ring(q, k, v)
+    want = vanilla_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ring_gqa_rejects_indivisible_heads(eight_devices):
+    """H not a multiple of H_kv is a layout bug, not a fallback case."""
+    mesh = make_mesh(dp=1, sp=2)
+    q = jnp.zeros((1, 4, 6, 8), jnp.float32)
+    k = v = jnp.zeros((1, 4, 4, 8), jnp.float32)
+    ring = make_ring_attention(mesh, batch_axis=None)
+    with pytest.raises(ValueError, match="multiple of k/v heads"):
+        jax.jit(ring)(q, k, v)
+
+
 def test_ring_with_data_axis(eight_devices):
     """dp=2 x sp=4: batch AND sequence sharded simultaneously."""
     mesh = make_mesh(dp=2, sp=4)
